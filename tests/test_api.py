@@ -1,0 +1,230 @@
+"""L1 API tests — the defaults_test.go / validation_test.go / helpers_test.go
+shape from the reference (SURVEY.md §4: pure-function tests, no cluster)."""
+
+import copy
+
+import pytest
+
+from tfk8s_tpu.api import (
+    CleanPodPolicy,
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    helpers,
+    serde,
+    set_defaults,
+    validate,
+)
+from tfk8s_tpu.utils import topology as topo
+
+
+def make_job(name="mnist", workers=1, accelerator="cpu-1", **kw):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ContainerSpec(entrypoint="tfk8s_tpu.models.mlp:train"),
+                )
+            },
+            tpu=TPUSpec(accelerator=accelerator, **kw),
+        ),
+    )
+
+
+# --- defaults ---------------------------------------------------------------
+
+
+def test_defaults_fill_unset_fields():
+    job = make_job()
+    job.spec.replica_specs[ReplicaType.WORKER].replicas = None
+    set_defaults(job)
+    ws = job.spec.replica_specs[ReplicaType.WORKER]
+    assert ws.replicas == 1
+    assert ws.restart_policy == RestartPolicy.ON_FAILURE
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.RUNNING
+    assert job.spec.run_policy.backoff_limit == 3
+    assert job.spec.mesh == MeshSpec(axes={"data": 1})
+
+
+def test_defaults_ps_restart_policy_is_always():
+    job = make_job()
+    job.spec.replica_specs[ReplicaType.PS] = ReplicaSpec(
+        template=ContainerSpec(entrypoint="x")
+    )
+    set_defaults(job)
+    assert job.spec.replica_specs[ReplicaType.PS].restart_policy == RestartPolicy.ALWAYS
+
+
+def test_defaults_idempotent():
+    job = set_defaults(make_job(accelerator="v5p-32"))
+    again = set_defaults(copy.deepcopy(job))
+    assert serde.to_dict(job) == serde.to_dict(again)
+
+
+def test_default_mesh_covers_all_chips():
+    job = set_defaults(make_job(accelerator="v5p-32", workers=4))
+    assert job.spec.mesh.axes == {"data": 16}
+
+
+# --- validation -------------------------------------------------------------
+
+
+def test_valid_job_passes():
+    assert validate(set_defaults(make_job())) == []
+
+
+def test_missing_name_and_replicas():
+    job = TPUJob()
+    errs = validate(job)
+    assert any("metadata.name" in e for e in errs)
+    assert any("replicaSpecs" in e for e in errs)
+
+
+def test_bad_dns_name():
+    job = set_defaults(make_job(name="Bad_Name"))
+    assert any("DNS-1123" in e for e in validate(job))
+
+
+def test_two_chiefs_rejected():
+    job = make_job()
+    job.spec.replica_specs[ReplicaType.CHIEF] = ReplicaSpec(
+        replicas=2, template=ContainerSpec(entrypoint="x")
+    )
+    assert any("at most one Chief" in e for e in validate(set_defaults(job)))
+
+
+def test_missing_entrypoint_rejected():
+    job = make_job()
+    job.spec.replica_specs[ReplicaType.WORKER].template = ContainerSpec()
+    assert any("entrypoint or image" in e for e in validate(set_defaults(job)))
+
+
+def test_unknown_accelerator_rejected():
+    job = set_defaults(make_job(accelerator="h100-8"))
+    assert any("spec.tpu" in e for e in validate(job))
+
+
+def test_host_count_mismatch_rejected():
+    # v5p-32 = 16 chips = 4 hosts; 3 workers is wrong.
+    job = set_defaults(make_job(accelerator="v5p-32", workers=3))
+    assert any("host" in e for e in validate(job))
+
+
+def test_host_count_match_accepted():
+    job = set_defaults(make_job(accelerator="v5p-32", workers=4))
+    assert validate(job) == []
+
+
+def test_mesh_size_mismatch_rejected():
+    job = set_defaults(make_job(accelerator="v5p-32", workers=4))
+    job.spec.mesh = MeshSpec(axes={"data": 4, "tensor": 2})
+    assert any("spec.mesh" in e for e in validate(job))
+
+
+def test_ps_only_job_rejected():
+    job = TPUJob(
+        metadata=ObjectMeta(name="ps-only"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.PS: ReplicaSpec(template=ContainerSpec(entrypoint="x"))
+            }
+        ),
+    )
+    assert any("Chief or Worker" in e for e in validate(set_defaults(job)))
+
+
+# --- serde ------------------------------------------------------------------
+
+
+def test_roundtrip_job():
+    job = set_defaults(make_job(accelerator="v5p-32", workers=4))
+    helpers.set_condition(job.status, JobConditionType.CREATED, reason="test")
+    back = serde.roundtrip(job)
+    assert isinstance(back, TPUJob)
+    assert serde.to_dict(back) == serde.to_dict(job)
+    # enum-keyed maps and enums decode to real enum types
+    assert ReplicaType.WORKER in back.spec.replica_specs
+    assert back.spec.replica_specs[ReplicaType.WORKER].restart_policy == RestartPolicy.ON_FAILURE
+    assert back.status.conditions[0].type == JobConditionType.CREATED
+
+
+def test_decode_unknown_kind_raises():
+    with pytest.raises(KeyError):
+        serde.decode_object({"kind": "Nope"})
+
+
+# --- helpers ----------------------------------------------------------------
+
+
+def test_replica_naming_and_process_ids():
+    job = make_job(name="bert", workers=3)
+    job.spec.replica_specs[ReplicaType.CHIEF] = ReplicaSpec(
+        replicas=1, template=ContainerSpec(entrypoint="x")
+    )
+    set_defaults(job)
+    assert helpers.replica_name("bert", ReplicaType.WORKER, 2) == "bert-worker-2"
+    # chief is process 0, workers follow
+    assert helpers.process_index(job, ReplicaType.CHIEF, 0) == 0
+    assert helpers.process_index(job, ReplicaType.WORKER, 0) == 1
+    assert helpers.process_index(job, ReplicaType.WORKER, 2) == 3
+    assert helpers.coordinator_address(job).startswith("bert-chief-0.default:")
+    eps = helpers.cluster_endpoints(job)
+    assert len(eps["worker"]) == 3 and len(eps["chief"]) == 1
+
+
+def test_conditions_exclusive_transitions():
+    job = make_job()
+    assert helpers.set_condition(job.status, JobConditionType.RUNNING)
+    assert helpers.has_condition(job.status, JobConditionType.RUNNING)
+    assert helpers.set_condition(job.status, JobConditionType.SUCCEEDED)
+    assert not helpers.has_condition(job.status, JobConditionType.RUNNING)
+    assert helpers.is_finished(job.status)
+    # idempotent: re-setting same condition+reason reports no change
+    assert not helpers.set_condition(job.status, JobConditionType.SUCCEEDED)
+
+
+# --- topology ---------------------------------------------------------------
+
+
+def test_topology_v5p():
+    info = topo.parse_accelerator("v5p-32")
+    assert (info.chips, info.hosts, info.cores_per_chip) == (16, 4, 2)
+    assert len(info.topology) == 3
+
+
+def test_topology_v5e_single_host():
+    info = topo.parse_accelerator("v5litepod-8")
+    assert (info.chips, info.hosts) == (8, 1)
+
+
+def test_topology_v5e_multi_host():
+    info = topo.parse_accelerator("v5litepod-16")
+    assert (info.chips, info.hosts) == (16, 4)
+
+
+def test_topology_explicit_grid_checked():
+    assert topo.parse_accelerator("v5p-32", "2x2x4").topology == (2, 2, 4)
+    with pytest.raises(topo.TopologyError):
+        topo.parse_accelerator("v5p-32", "2x2x2")
+
+
+def test_topology_odd_core_count_rejected():
+    with pytest.raises(topo.TopologyError):
+        topo.parse_accelerator("v5p-7")
+
+
+def test_default_topology_balanced():
+    info = topo.parse_accelerator("v4-64")  # 32 chips
+    assert len(info.topology) == 3
+    import math
+
+    assert math.prod(info.topology) == 32

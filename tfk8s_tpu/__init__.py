@@ -1,0 +1,27 @@
+"""tfk8s_tpu — a TPU-native distributed-training job framework.
+
+A brand-new framework with the capabilities of the tensorflow-k8s TFJob
+operator (studied in SURVEY.md): a declarative ``TPUJob`` resource, an
+informer-driven level-triggered reconcile loop, gang-scheduled ICI-topology
+aware slice provisioning, and a JAX/XLA data plane where data/model/sequence
+parallelism run as GSPMD collectives over ICI.
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``tfk8s_tpu.api``        L1  resource schema: types, defaults, validation
+- ``tfk8s_tpu.client``     L2  clients, informers, listers, workqueue
+                           L0  (fake) in-memory cluster store with List/Watch
+- ``tfk8s_tpu.controller`` L4  reconcile loop, leader election
+- ``tfk8s_tpu.trainer``    L3  TPUJob -> gang of replica pods/services
+- ``tfk8s_tpu.runtime``        data-plane launcher: mesh, train loop, ckpt
+- ``tfk8s_tpu.parallel``       mesh axes, sharding rules, collectives
+- ``tfk8s_tpu.models``         MLP / ResNet-50 / BERT / T5 / DLRM
+- ``tfk8s_tpu.ops``            pallas TPU kernels (+ XLA fallbacks)
+- ``tfk8s_tpu.cli``        L5  operator entrypoint (options -> server -> run)
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "tpu.tfk8s.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"

@@ -1,0 +1,36 @@
+"""L1 API layer: TPUJob schema, defaults, validation, helpers, serde.
+
+Equivalent of the reference's ``pkg/apis/tensorflow/`` tree (SURVEY.md
+C4-C9; images/tf3.PNG at k8s-operator.md:229).
+"""
+
+from tfk8s_tpu.api.types import (  # noqa: F401
+    CleanPodPolicy,
+    Condition,
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUJobStatus,
+    TPUSpec,
+)
+from tfk8s_tpu.api.defaults import set_defaults  # noqa: F401
+from tfk8s_tpu.api.validation import ValidationError, validate, validate_or_raise  # noqa: F401
+from tfk8s_tpu.api import helpers  # noqa: F401
+from tfk8s_tpu.api import serde  # noqa: F401

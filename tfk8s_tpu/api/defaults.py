@@ -1,0 +1,60 @@
+"""Defaulting for TPUJob specs — the ``defaults.go`` equivalent (SURVEY.md
+C6). Idempotent: ``set_defaults(set_defaults(job)) == set_defaults(job)``.
+
+Defaults chosen to mirror the reference's semantics where they exist:
+``restart_policy`` defaults to OnFailure — the in-place-restart behavior the
+doc singles out (k8s-operator.md:47-49) — and PS replicas default to Always
+(a parameter server is a long-running service, never 'done'). TPU-specific
+defaults (mesh = pure data-parallel over all chips) are new surface.
+"""
+
+from __future__ import annotations
+
+from tfk8s_tpu.api.types import (
+    CleanPodPolicy,
+    MeshSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+)
+from tfk8s_tpu.utils import topology as topo
+
+DEFAULT_ACCELERATOR = "cpu-1"
+DEFAULT_MAX_RESTARTS = 3
+DEFAULT_BACKOFF_LIMIT = 3
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Fill unset spec fields in place and return the job."""
+    spec = job.spec
+
+    for rtype, rspec in spec.replica_specs.items():
+        if rspec.replicas is None:
+            rspec.replicas = 1
+        if rspec.restart_policy is None:
+            rspec.restart_policy = (
+                RestartPolicy.ALWAYS if rtype == ReplicaType.PS else RestartPolicy.ON_FAILURE
+            )
+        if rspec.max_restarts is None:
+            rspec.max_restarts = DEFAULT_MAX_RESTARTS
+
+    if not spec.tpu.accelerator:
+        spec.tpu.accelerator = DEFAULT_ACCELERATOR
+    # num_slices < 1 is left as-is: validation reports it (clamping here
+    # would make the numSlices validation error unreachable).
+
+    rp = spec.run_policy
+    if rp.clean_pod_policy is None:
+        rp.clean_pod_policy = CleanPodPolicy.RUNNING
+    if rp.backoff_limit is None:
+        rp.backoff_limit = DEFAULT_BACKOFF_LIMIT
+
+    # Default mesh: one pure data-parallel axis over every chip in the job.
+    if spec.mesh is None:
+        try:
+            info = topo.parse_accelerator(spec.tpu.accelerator, spec.tpu.topology)
+            spec.mesh = MeshSpec(axes={"data": info.chips * max(spec.tpu.num_slices, 1)})
+        except topo.TopologyError:
+            pass  # malformed accelerator -> leave unset; validation reports it
+
+    return job

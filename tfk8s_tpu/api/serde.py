@@ -1,0 +1,99 @@
+"""Scheme registration + (de)serialization — the equivalent of the
+reference's ``register.go`` / ``zz_generated.deepcopy.go`` codec layer
+(SURVEY.md C5/C9; ``SchemeGroupVersion`` + ``DirectCodecFactory`` in
+images/tf6.PNG).
+
+Where the reference registers Go types with a runtime.Scheme and lets
+codegen produce deepcopy/codecs, here a single generic encoder/decoder walks
+the dataclass field types: enums serialize by value, enum-keyed dicts (the
+``replica_specs`` map) serialize by the enum's value, and kinds round-trip
+through the ``SCHEME`` registry keyed by the object's ``kind`` field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Type, get_args, get_origin, get_type_hints
+
+from tfk8s_tpu.api import types as t
+
+# kind -> class; the runtime.Scheme equivalent.
+SCHEME: Dict[str, type] = dict(t.TOP_LEVEL_KINDS)
+
+
+def register(kind: str, cls: type) -> None:
+    SCHEME[kind] = cls
+
+
+def to_dict(obj: Any) -> Any:
+    """Encode a dataclass (or nested structure) to JSON-safe primitives."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_dict(getattr(obj, f.name))
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {_key_to_str(k): to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _key_to_str(k: Any) -> str:
+    return k.value if isinstance(k, enum.Enum) else str(k)
+
+
+def from_dict(cls: Type, data: Any) -> Any:
+    """Decode primitives into an instance of dataclass ``cls``, following the
+    declared field types (including Optional/List/Dict and enum keys)."""
+    return _decode(cls, data)
+
+
+def decode_object(data: Dict[str, Any]) -> Any:
+    """Decode a top-level object by its ``kind`` via the scheme."""
+    kind = data.get("kind", "")
+    if kind not in SCHEME:
+        raise KeyError(f"kind {kind!r} is not registered in the scheme")
+    return _decode(SCHEME[kind], data)
+
+
+def _decode(tp: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _decode(args[0], data) if args else data
+    if origin in (dict,):
+        kt, vt = get_args(tp) or (str, Any)
+        return {_decode(kt, k): _decode(vt, v) for k, v in data.items()}
+    if origin is tuple:
+        args = get_args(tp)
+        if len(args) == 2 and args[1] is Ellipsis:  # Tuple[X, ...]
+            return tuple(_decode(args[0], v) for v in data)
+        if args:  # fixed-arity Tuple[X, Y, ...]
+            return tuple(_decode(a, v) for a, v in zip(args, data))
+        return tuple(data)
+    if origin is list:
+        (vt,) = get_args(tp) or (Any,)
+        return [_decode(vt, v) for v in data]
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if dataclasses.is_dataclass(tp):
+        hints = get_type_hints(tp)
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            if f.name in data:
+                kwargs[f.name] = _decode(hints[f.name], data[f.name])
+        return tp(**kwargs)
+    return data
+
+
+def roundtrip(obj: Any) -> Any:
+    """Encode then decode via the scheme — used by tests to assert lossless
+    round-trip serialization (the ``DirectCodecFactory`` parity check)."""
+    return decode_object(to_dict(obj))

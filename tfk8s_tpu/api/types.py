@@ -1,0 +1,333 @@
+"""L1 API types: the TPUJob resource and the core objects it reconciles to.
+
+TPU-native re-design of the reference's ``pkg/apis/tensorflow/v1alpha1/types.go``
+(SURVEY.md C4; domain model at k8s-operator.md:6 — *task = one process per
+machine; tasks form a job; jobs are PS or WORKER; jobs form a cluster*).
+
+Differences from the reference, by design (SURVEY.md §0 north star):
+
+- replica sets request TPU slices (``TPUSpec``: accelerator type + topology +
+  num_slices) instead of ``nvidia.com/gpu`` counts;
+- the job carries an optional ``MeshSpec`` — the logical device-mesh axes
+  (data/fsdp/tensor/sequence/expert/pipeline) the data plane will build with
+  ``jax.sharding.Mesh`` — because on TPU the parallelism layout is a
+  *scheduling* concern (slice shape must match mesh shape), not a container
+  detail;
+- restart semantics keep the reference's ``OnFailure`` / ``Never`` meaning
+  (k8s-operator.md:47-49) but add gang semantics: a TPU slice fails as a
+  unit, so replica-level restart escalates to whole-gang restart-from-
+  checkpoint (SURVEY.md §2 "Elastic / gang semantics").
+
+Everything is a plain dataclass; serialization lives in ``api/serde.py``
+(the scheme-registration equivalent of the reference's ``register.go``, C5).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tfk8s_tpu import API_VERSION
+
+
+# ---------------------------------------------------------------------------
+# Metadata (the k8s ObjectMeta equivalent; finalizer/deletion semantics per
+# k8s-operator.md:36-43)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OwnerReference:
+    """Back-pointer from a child object (pod/service) to its owning TPUJob."""
+
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 1
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: Optional[float] = None
+    # Deletion only *marks* the object; controllers run finalizers and then
+    # clear them, at which point the store actually removes the object
+    # (k8s-operator.md:36-43).
+    deletion_timestamp: Optional[float] = None
+
+    @property
+    def key(self) -> str:
+        """The ``namespace/name`` cache key (MetaNamespaceKeyFunc)."""
+        return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Enums
+# ---------------------------------------------------------------------------
+
+
+class ReplicaType(str, enum.Enum):
+    """Replica roles. CHIEF/WORKER/PS mirror the reference's job types
+    (k8s-operator.md:6; 'master/chief per north star' SURVEY.md C4)."""
+
+    CHIEF = "Chief"
+    WORKER = "Worker"
+    PS = "PS"
+    EVALUATOR = "Evaluator"
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart semantics (k8s-operator.md:47-49):
+
+    - ON_FAILURE: restart the task in place.
+    - NEVER: a failed task is replaced by a fresh one; the failed record is
+      kept for inspection (completed pods are not auto-deleted,
+      k8s-operator.md:50-52).
+    - ALWAYS: restart regardless of exit status (long-running PS tasks).
+    - EXIT_CODE: retryable exit codes restart in place, permanent codes fail
+      the replica.
+    """
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to clean up when the job finishes."""
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class JobConditionType(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    SCHEDULED = "Scheduled"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+# ---------------------------------------------------------------------------
+# Spec
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerSpec:
+    """What each replica task runs. ``entrypoint`` names a registered Python
+    callable (the in-process/local backend analogue of an image+command);
+    ``image``/``command`` are carried for real-cluster rendering parity."""
+
+    entrypoint: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    resources: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica set (the reference's TFReplicaSpec): N tasks of one role."""
+
+    replicas: Optional[int] = None
+    restart_policy: Optional[RestartPolicy] = None
+    # Cap on per-replica restarts before the whole job is failed.
+    max_restarts: Optional[int] = None
+    template: ContainerSpec = field(default_factory=ContainerSpec)
+
+
+@dataclass
+class TPUSpec:
+    """TPU slice request — replaces the reference's nvidia.com/gpu resource
+    counts (north star, BASELINE.json). ``accelerator`` is a type string like
+    ``v5p-32`` / ``v5litepod-8`` / ``cpu`` (hermetic tests); ``topology`` an
+    optional explicit chip grid like ``2x2x4``; ``num_slices`` > 1 means
+    multislice over DCN."""
+
+    accelerator: str = ""
+    topology: str = ""
+    num_slices: int = 1
+
+
+@dataclass
+class MeshSpec:
+    """Logical device-mesh axes for the data plane, in order. Axis names
+    follow the scaling-book convention: data / fsdp / tensor / sequence /
+    expert / pipeline (SURVEY.md §2 parallelism table). The product of sizes
+    must equal chips-per-slice x num_slices (validated in api/validation.py).
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def size(self) -> int:
+        n = 1
+        for v in self.axes.values():
+            n *= v
+        return n
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs. TPU slices admit all-or-nothing by hardware
+    construction (SURVEY.md §2 'Elastic / gang semantics')."""
+
+    gang: bool = True
+    priority: int = 0
+    # Max seconds a gang may sit Pending before the job is marked Failed.
+    admission_timeout_s: Optional[float] = None
+
+
+@dataclass
+class RunPolicy:
+    clean_pod_policy: Optional[CleanPodPolicy] = None
+    ttl_seconds_after_finished: Optional[float] = None
+    active_deadline_seconds: Optional[float] = None
+    # Whole-gang restarts-from-checkpoint before the job is failed.
+    backoff_limit: Optional[int] = None
+    scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+
+
+@dataclass
+class TPUJobSpec:
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    tpu: TPUSpec = field(default_factory=TPUSpec)
+    mesh: Optional[MeshSpec] = None
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+
+
+# ---------------------------------------------------------------------------
+# Status
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Condition:
+    type: JobConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    restarts: int = 0
+
+
+@dataclass
+class TPUJobStatus:
+    conditions: List[Condition] = field(default_factory=list)
+    replica_statuses: Dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    # Whole-gang restarts performed so far (counts against backoff_limit).
+    gang_restarts: int = 0
+    # Checkpoint step the gang last persisted (resume point on restart).
+    checkpoint_step: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Top-level objects
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TPUJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+    api_version: str = API_VERSION
+    kind: str = "TPUJob"
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PodSpec:
+    containers: List[ContainerSpec] = field(default_factory=list)
+    # Topology placement request: which slice / which host within the slice
+    # this task must land on (filled by the trainer, consumed by the
+    # scheduler; SURVEY.md §7 hard part 1).
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    restart_policy: RestartPolicy = RestartPolicy.NEVER
+    scheduler_name: str = "gang"
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    exit_code: Optional[int] = None
+    message: str = ""
+    host: str = ""
+    restarts: int = 0
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    api_version: str = "v1"
+    kind: str = "Pod"
+
+    def deepcopy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    api_version: str = "v1"
+    kind: str = "Service"
+
+    def deepcopy(self) -> "Service":
+        return copy.deepcopy(self)
+
+
+# All registerable top-level kinds, for the scheme (serde.py).
+TOP_LEVEL_KINDS = {
+    "TPUJob": TPUJob,
+    "Pod": Pod,
+    "Service": Service,
+}

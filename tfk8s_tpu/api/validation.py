@@ -1,0 +1,112 @@
+"""TPUJob spec validation — the ``validation/validation.go`` equivalent
+(SURVEY.md C7). Returns the full list of problems (field path + message)
+rather than failing fast, so a user fixes a spec in one round trip.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tfk8s_tpu.api.types import ReplicaType, TPUJob
+from tfk8s_tpu.utils import topology as topo
+
+# DNS-1123 label: what k8s accepts for object names.
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+MAX_NAME_LEN = 63
+
+
+class ValidationError(ValueError):
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+def validate(job: TPUJob) -> List[str]:
+    """Validate a (defaulted) TPUJob. Returns a list of error strings —
+    empty means valid."""
+    errs: List[str] = []
+    meta, spec = job.metadata, job.spec
+
+    if not meta.name:
+        errs.append("metadata.name: required")
+    elif len(meta.name) > MAX_NAME_LEN or not _NAME_RE.match(meta.name):
+        errs.append(
+            f"metadata.name: {meta.name!r} must be a DNS-1123 label "
+            f"(<= {MAX_NAME_LEN} chars, [a-z0-9-])"
+        )
+    if not meta.namespace:
+        errs.append("metadata.namespace: required")
+
+    if not spec.replica_specs:
+        errs.append("spec.replicaSpecs: at least one replica set is required")
+    for rtype, rspec in spec.replica_specs.items():
+        path = f"spec.replicaSpecs[{rtype.value}]"
+        if rspec.replicas is not None and rspec.replicas < 0:
+            errs.append(f"{path}.replicas: must be >= 0, got {rspec.replicas}")
+        if rtype == ReplicaType.CHIEF and (rspec.replicas or 0) > 1:
+            errs.append(f"{path}.replicas: at most one Chief, got {rspec.replicas}")
+        if not rspec.template.entrypoint and not rspec.template.image:
+            errs.append(f"{path}.template: entrypoint or image is required")
+        if rspec.max_restarts is not None and rspec.max_restarts < 0:
+            errs.append(f"{path}.maxRestarts: must be >= 0")
+    compute = {
+        rt: rs
+        for rt, rs in spec.replica_specs.items()
+        if rt in (ReplicaType.CHIEF, ReplicaType.WORKER)
+    }
+    n_compute = sum(rs.replicas or 0 for rs in compute.values())
+    if spec.replica_specs and n_compute == 0:
+        errs.append(
+            "spec.replicaSpecs: at least one Chief or Worker replica is required"
+        )
+
+    info = None
+    if spec.tpu.accelerator:
+        try:
+            info = topo.parse_accelerator(spec.tpu.accelerator, spec.tpu.topology)
+        except topo.TopologyError as e:
+            errs.append(f"spec.tpu: {e}")
+    if spec.tpu.num_slices < 1:
+        errs.append(f"spec.tpu.numSlices: must be >= 1, got {spec.tpu.num_slices}")
+
+    # Gang consistency: the compute replicas are the slice's hosts. One JAX
+    # process per host (SURVEY.md §3.3 'pod scheduled onto TPU VM; JAX
+    # process attaches to its chips'), so compute replica count must equal
+    # hosts-per-slice x num_slices.
+    if info is not None and info.generation != "cpu":
+        want = info.hosts * max(spec.tpu.num_slices, 1)
+        if n_compute and n_compute != want:
+            errs.append(
+                f"spec.replicaSpecs: {n_compute} compute replicas (Chief+Worker) "
+                f"but {spec.tpu.accelerator} x{spec.tpu.num_slices} has {want} "
+                f"host(s); one process per host"
+            )
+
+    if spec.mesh is not None:
+        for name, size in spec.mesh.axes.items():
+            if size < 1:
+                errs.append(f"spec.mesh.axes[{name}]: must be >= 1, got {size}")
+        if info is not None:
+            want = info.chips * max(spec.tpu.num_slices, 1)
+            if spec.mesh.size() != want:
+                errs.append(
+                    f"spec.mesh: axes product {spec.mesh.size()} != total chips {want} "
+                    f"({spec.tpu.accelerator} x {spec.tpu.num_slices})"
+                )
+
+    rp = job.spec.run_policy
+    if rp.backoff_limit is not None and rp.backoff_limit < 0:
+        errs.append("spec.runPolicy.backoffLimit: must be >= 0")
+    if rp.active_deadline_seconds is not None and rp.active_deadline_seconds <= 0:
+        errs.append("spec.runPolicy.activeDeadlineSeconds: must be > 0")
+    if rp.ttl_seconds_after_finished is not None and rp.ttl_seconds_after_finished < 0:
+        errs.append("spec.runPolicy.ttlSecondsAfterFinished: must be >= 0")
+
+    return errs
+
+
+def validate_or_raise(job: TPUJob) -> None:
+    errs = validate(job)
+    if errs:
+        raise ValidationError(errs)

@@ -1,0 +1,1 @@
+"""Shared utilities: topology math, structured logging, clocks."""

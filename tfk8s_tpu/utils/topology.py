@@ -1,0 +1,161 @@
+"""TPU slice topology math.
+
+The reference is topology-agnostic — its scaling axis is replica count
+(k8s-operator.md:6) and a GPU pod is a fungible resource. On TPU the unit of
+scheduling is a *slice*: an ICI-connected grid of chips carved from a pod,
+requested by accelerator type (``v5p-32``) and optionally an explicit chip
+grid (``2x2x4``). Gang admission, mesh construction, and placement all hang
+off this module (SURVEY.md §7 hard part 1).
+
+Naming conventions follow Cloud TPU:
+
+- ``v4-N`` / ``v5p-N``: N counts *TensorCores*, 2 per chip -> N/2 chips,
+  4 chips per host, 3-D ICI torus.
+- ``v5litepod-N`` / ``v6e-N``: N counts chips, 2-D ICI grid; single host up
+  to 8 chips, 4 chips per host beyond.
+- ``cpu-N`` (hermetic tests / local backend): N virtual devices, one host,
+  no ICI — stands in for a slice the way the reference's fake clientset
+  stands in for an apiserver (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import List, Tuple
+
+_GEN_RE = re.compile(r"^(v[0-9]+[a-z]*|cpu|v5litepod)(?:-([0-9]+))?$")
+
+# generation -> (counts_cores, cores_per_chip, chips_per_host, ici_dims)
+_GENERATIONS = {
+    "v2": (True, 2, 4, 2),
+    "v3": (True, 2, 4, 2),
+    "v4": (True, 2, 4, 3),
+    "v5p": (True, 2, 4, 3),
+    "v5litepod": (False, 1, 4, 2),
+    "v5e": (False, 1, 4, 2),
+    "v6e": (False, 1, 4, 2),
+    "cpu": (False, 1, None, 1),  # all devices on one host
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceInfo:
+    """Resolved shape of one slice of an accelerator type."""
+
+    accelerator: str
+    generation: str
+    chips: int
+    cores_per_chip: int
+    hosts: int
+    topology: Tuple[int, ...]  # chip grid, e.g. (2, 2, 4)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    @property
+    def cores(self) -> int:
+        return self.chips * self.cores_per_chip
+
+
+class TopologyError(ValueError):
+    pass
+
+
+def parse_topology(s: str) -> Tuple[int, ...]:
+    """``"2x2x4"`` -> ``(2, 2, 4)``."""
+    try:
+        dims = tuple(int(p) for p in s.lower().split("x"))
+    except ValueError:
+        raise TopologyError(f"malformed topology {s!r}")
+    if not dims or any(d < 1 for d in dims):
+        raise TopologyError(f"malformed topology {s!r}")
+    return dims
+
+
+def default_topology(chips: int, ndims: int) -> Tuple[int, ...]:
+    """Near-cubic factorization of ``chips`` into an ``ndims``-D grid,
+    preferring balanced dims (an ICI torus wants compact shapes)."""
+    if ndims <= 1:
+        return (chips,)
+    dims = [1] * ndims
+    # Peel off prime factors largest-first onto the currently-smallest dim.
+    for p in _prime_factors(chips):
+        dims[dims.index(min(dims))] *= p
+    return tuple(sorted(dims))
+
+
+def _prime_factors(n: int) -> List[int]:
+    out = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+def parse_accelerator(accelerator: str, topology: str = "") -> SliceInfo:
+    """Resolve an accelerator type string (+ optional explicit topology) into
+    a :class:`SliceInfo`. Raises :class:`TopologyError` on malformed or
+    inconsistent requests — surfaced to users via api/validation.py."""
+    acc = accelerator.strip().lower()
+    m = _GEN_RE.match(acc)
+    if not m:
+        raise TopologyError(f"unknown accelerator type {accelerator!r}")
+    gen, size = m.group(1), m.group(2)
+    if gen not in _GENERATIONS:
+        raise TopologyError(f"unknown accelerator generation {gen!r}")
+    counts_cores, cores_per_chip, chips_per_host, ndims = _GENERATIONS[gen]
+
+    n = int(size) if size else 1
+    if n < 1:
+        raise TopologyError(f"accelerator size must be >= 1, got {accelerator!r}")
+    if counts_cores:
+        if n % cores_per_chip:
+            raise TopologyError(
+                f"{gen} sizes count TensorCores ({cores_per_chip}/chip); "
+                f"{n} is not a multiple of {cores_per_chip}"
+            )
+        chips = n // cores_per_chip
+    else:
+        chips = n
+
+    if topology:
+        topo = parse_topology(topology)
+        if math.prod(topo) != chips:
+            raise TopologyError(
+                f"topology {topology!r} has {math.prod(topo)} chips but "
+                f"{accelerator!r} has {chips}"
+            )
+        if gen != "cpu" and len(topo) != ndims:
+            raise TopologyError(
+                f"{gen} slices have a {ndims}-D ICI grid; topology "
+                f"{topology!r} is {len(topo)}-D"
+            )
+    else:
+        topo = default_topology(chips, ndims)
+
+    if chips_per_host is None or chips <= (8 if gen in ("v5litepod", "v5e", "v6e") else chips_per_host):
+        hosts = 1
+    else:
+        if chips % chips_per_host:
+            raise TopologyError(
+                f"{accelerator!r}: {chips} chips not divisible into "
+                f"{chips_per_host}-chip hosts"
+            )
+        hosts = chips // chips_per_host
+
+    return SliceInfo(
+        accelerator=acc,
+        generation=gen,
+        chips=chips,
+        cores_per_chip=cores_per_chip,
+        hosts=hosts,
+        topology=topo,
+    )
