@@ -1,0 +1,146 @@
+"""L4 core tests: the generic controller loop against the fake substrate,
+plus leader election — the §3.1/§3.2 machinery with a toy sync."""
+
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec
+from tfk8s_tpu.client import FakeClientset, SharedIndexInformer
+from tfk8s_tpu.controller import Controller, LeaderElector
+
+
+def job(name="j1"):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint="e")
+                )
+            }
+        ),
+    )
+
+
+def start_controller(cs, sync, **kw):
+    inf = SharedIndexInformer(cs.tpujobs(namespace=None))
+    ctrl = Controller("test", sync, informers=[inf], **kw)
+    inf.add_event_handler(ctrl.default_handler())
+    stop = threading.Event()
+    ok = ctrl.run(workers=2, stop=stop, block=False)
+    assert ok
+    return ctrl, inf, stop
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_controller_syncs_created_objects():
+    cs = FakeClientset()
+    seen = []
+    ctrl, inf, stop = start_controller(cs, lambda key: seen.append(key))
+    cs.tpujobs().create(job("a"))
+    cs.tpujobs().create(job("b"))
+    assert wait_for(lambda: {"default/a", "default/b"} <= set(seen))
+    stop.set()
+    ctrl.shutdown()
+
+
+def test_controller_retries_with_backoff_then_succeeds():
+    cs = FakeClientset()
+    attempts = []
+
+    def flaky(key):
+        attempts.append(key)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+
+    ctrl, inf, stop = start_controller(cs, flaky)
+    cs.tpujobs().create(job("a"))
+    assert wait_for(lambda: len(attempts) >= 3)
+    # after success the failure count is forgotten
+    assert wait_for(lambda: ctrl.queue.num_requeues("default/a") == 0)
+    stop.set()
+    ctrl.shutdown()
+
+
+def test_controller_drops_after_max_retries():
+    cs = FakeClientset()
+    attempts = []
+
+    def always_fails(key):
+        attempts.append(key)
+        raise RuntimeError("permanent")
+
+    ctrl, inf, stop = start_controller(cs, always_fails, max_retries=2)
+    cs.tpujobs().create(job("a"))
+    assert wait_for(lambda: len(ctrl.recorder.events(reason="SyncDropped")) == 1, timeout=10)
+    n = len(attempts)
+    time.sleep(0.2)
+    assert len(attempts) == n  # no further retries after drop
+    stop.set()
+    ctrl.shutdown()
+
+
+def test_update_filter_skips_noop_resyncs():
+    cs = FakeClientset()
+    seen = []
+    ctrl, inf, stop = start_controller(cs, lambda key: seen.append(key))
+    j = cs.tpujobs().create(job("a"))
+    assert wait_for(lambda: seen.count("default/a") >= 1)
+    n = len(seen)
+    # same-rv updates (as a resync would deliver) are filtered out
+    h = ctrl.default_handler()
+    h.on_update(j, j)
+    time.sleep(0.1)
+    assert len(seen) == n
+    stop.set()
+    ctrl.shutdown()
+
+
+# --- leader election --------------------------------------------------------
+
+
+def test_single_winner_among_racing_candidates():
+    cs = FakeClientset()
+    clk = [0.0]
+    mk = lambda ident: LeaderElector(
+        cs.generic("Lease"), ident, lease_duration_s=10, clock=lambda: clk[0]
+    )
+    a, b = mk("a"), mk("b")
+    got_a = a.try_acquire_or_renew()
+    got_b = b.try_acquire_or_renew()
+    assert got_a and not got_b
+
+
+def test_takeover_after_expiry_and_transitions_counted():
+    cs = FakeClientset()
+    clk = [0.0]
+    mk = lambda ident: LeaderElector(
+        cs.generic("Lease"), ident, lease_duration_s=10, clock=lambda: clk[0]
+    )
+    a, b = mk("a"), mk("b")
+    assert a.try_acquire_or_renew()
+    clk[0] = 5.0
+    assert not b.try_acquire_or_renew()  # still held
+    clk[0] = 20.0  # expired
+    assert b.try_acquire_or_renew()
+    lease = cs.generic("Lease").get("tfk8s-tpu-operator")
+    assert lease.spec.holder == "b" and lease.spec.lease_transitions == 1
+
+
+def test_release_lets_standby_take_over_immediately():
+    cs = FakeClientset()
+    a = LeaderElector(cs.generic("Lease"), "a")
+    b = LeaderElector(cs.generic("Lease"), "b")
+    assert a.try_acquire_or_renew()
+    a.release()
+    assert b.try_acquire_or_renew()

@@ -325,9 +325,30 @@ class Service:
         return copy.deepcopy(self)
 
 
+@dataclass
+class LeaseSpec:
+    holder: str = ""
+    lease_duration_s: float = 15.0
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    """Leader-election lease (SURVEY.md C17: 'uses the leaderelection
+    package for high availability', k8s-operator.md:59,237)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+    api_version: str = "coordination/v1"
+    kind: str = "Lease"
+
+
 # All registerable top-level kinds, for the scheme (serde.py).
 TOP_LEVEL_KINDS = {
     "TPUJob": TPUJob,
     "Pod": Pod,
     "Service": Service,
+    "Lease": Lease,
 }

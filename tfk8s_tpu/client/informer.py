@@ -1,0 +1,254 @@
+"""Shared informer machinery — SURVEY.md C13.
+
+The architecture is the reference's exactly (images/informer1.png at
+k8s-operator.md:60): a **Reflector** List/Watches the (fake) apiserver,
+feeds a local **indexed store**, and dispatches OnAdd/OnUpdate/OnDelete
+callbacks from which controllers enqueue keys. Reads during reconcile hit
+the local store, never the server (k8s-operator.md:160).
+
+Protocol details carried over:
+
+- List-then-Watch from the returned resource_version; on a ``Gone`` (410)
+  the reflector **relists** and the store ``replace()`` computes the diff —
+  items that vanished during the gap are delivered as deletions with the
+  last-known state (the DeletedFinalStateUnknown path,
+  k8s-operator.md:162-164 'deleted-object handling').
+- ``wait_for_cache_sync`` blocks until the initial list has been replayed
+  into handlers (cache.WaitForCacheSync, k8s-operator.md:192).
+- Optional periodic **resync** re-delivers OnUpdate for every cached object
+  — the level-triggered safety net.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from tfk8s_tpu.client.store import EventType, Gone
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("informer")
+
+
+def meta_namespace_key(obj: Any) -> str:
+    """MetaNamespaceKeyFunc: ``namespace/name``."""
+    return obj.metadata.key
+
+
+@dataclasses.dataclass
+class DeletedFinalStateUnknown:
+    """Wrapper delivered to OnDelete when the deletion was observed via a
+    relist gap rather than a watch event (cache.DeletionHandlingMeta-
+    NamespaceKeyFunc's reason to exist, k8s-operator.md:132-139)."""
+
+    key: str
+    obj: Any
+
+
+def deletion_handling_key(obj: Any) -> str:
+    if isinstance(obj, DeletedFinalStateUnknown):
+        return obj.key
+    return meta_namespace_key(obj)
+
+
+class Indexer:
+    """Thread-safe keyed cache with a namespace index — the informer's local
+    store (``GetByKey`` read path, k8s-operator.md:160)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._items: Dict[str, Any] = {}
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        with self._lock:
+            obj = self._items.get(key)
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, namespace: Optional[str] = None) -> List[Any]:
+        with self._lock:
+            return [
+                copy.deepcopy(o)
+                for o in self._items.values()
+                if namespace is None or o.metadata.namespace == namespace
+            ]
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items)
+
+    def add(self, obj: Any) -> None:
+        with self._lock:
+            self._items[meta_namespace_key(obj)] = copy.deepcopy(obj)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._items.pop(key, None)
+
+    def replace(self, objs: List[Any]) -> List[Any]:
+        """Atomically swap contents; returns the displaced objects that are
+        absent from the new set (for DeletedFinalStateUnknown delivery)."""
+        with self._lock:
+            new = {meta_namespace_key(o): copy.deepcopy(o) for o in objs}
+            gone = [copy.deepcopy(o) for k, o in self._items.items() if k not in new]
+            self._items = new
+            return gone
+
+
+@dataclasses.dataclass
+class ResourceEventHandler:
+    """OnAdd/OnUpdate/OnDelete callback set (k8s-operator.md:121-128)."""
+
+    on_add: Optional[Callable[[Any], None]] = None
+    on_update: Optional[Callable[[Any, Any], None]] = None
+    on_delete: Optional[Callable[[Any], None]] = None
+
+
+class SharedIndexInformer:
+    """Reflector + indexer + handler dispatch for one kind."""
+
+    def __init__(self, client, resync_period: float = 0.0, name: str = ""):
+        """``client`` is a TypedClient-shaped object with ``list()`` and
+        ``watch(since_rv)`` — the ListWatch pair (k8s-operator.md:110-118)."""
+        self._client = client
+        self._resync_period = resync_period
+        self.name = name or getattr(client, "kind", "informer")
+        self.indexer = Indexer()
+        self._handlers: List[ResourceEventHandler] = []
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._watch = None
+        self._lock = threading.Lock()
+
+    # -- public api ---------------------------------------------------------
+
+    def add_event_handler(self, handler: ResourceEventHandler) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def run(self, stop: threading.Event) -> None:
+        """Start the reflector loop in its own thread (the ``go
+        informer.Run(stopCh)`` of k8s-operator.md:189)."""
+        self._stop = stop
+        self._thread = threading.Thread(
+            target=self._reflector_loop, name=f"reflector-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            # unblock a pending watch read
+            if self._watch is not None:
+                self._watch.stop()
+            self._thread.join(timeout)
+
+    # -- handler dispatch ---------------------------------------------------
+
+    def _dispatch_add(self, obj: Any) -> None:
+        for h in list(self._handlers):
+            if h.on_add:
+                h.on_add(copy.deepcopy(obj))
+
+    def _dispatch_update(self, old: Any, new: Any) -> None:
+        for h in list(self._handlers):
+            if h.on_update:
+                h.on_update(copy.deepcopy(old) if old is not None else None, copy.deepcopy(new))
+
+    def _dispatch_delete(self, obj: Any) -> None:
+        for h in list(self._handlers):
+            if h.on_delete:
+                h.on_delete(copy.deepcopy(obj) if not isinstance(obj, DeletedFinalStateUnknown) else obj)
+
+    # -- reflector ----------------------------------------------------------
+
+    def _list_and_sync(self) -> int:
+        """Initial (or recovery) List: replace the cache, emit synthetic
+        events for the diff, return the rv to watch from."""
+        items, rv = self._client.list()
+        displaced = self.indexer.replace(items)
+        for obj in displaced:
+            self._dispatch_delete(DeletedFinalStateUnknown(meta_namespace_key(obj), obj))
+        for obj in items:
+            self._dispatch_add(obj)
+        return rv
+
+    def _reflector_loop(self) -> None:
+        assert self._stop is not None
+        backoff = 0.05
+        rv: Optional[int] = None
+        last_resync = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    rv = self._list_and_sync()
+                    self._synced.set()
+                try:
+                    self._watch = self._client.watch(since_rv=rv)
+                except Gone:
+                    log.info("%s: watch rv %s too old; relisting", self.name, rv)
+                    rv = None
+                    continue
+                backoff = 0.05
+                while not self._stop.is_set():
+                    ev = self._watch.next(timeout=0.2)
+                    if ev is None:
+                        if self._watch._stopped:  # server closed the stream
+                            break
+                        if (
+                            self._resync_period
+                            and time.monotonic() - last_resync > self._resync_period
+                        ):
+                            last_resync = time.monotonic()
+                            for obj in self.indexer.list():
+                                self._dispatch_update(obj, obj)
+                        continue
+                    rv = max(rv or 0, ev.object.metadata.resource_version)
+                    self._handle_event(ev)
+            except Exception:  # noqa: BLE001 — reflector must survive anything
+                log.exception("%s: reflector error; backing off %.2fs", self.name, backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
+                rv = None  # full relist on recovery
+            finally:
+                if self._watch is not None:
+                    self._watch.stop()
+                    self._watch = None
+
+    def _handle_event(self, ev) -> None:
+        key = meta_namespace_key(ev.object)
+        if ev.type == EventType.ADDED:
+            old = self.indexer.get_by_key(key)
+            self.indexer.add(ev.object)
+            if old is None:
+                self._dispatch_add(ev.object)
+            else:  # replayed ADD for an object we already have
+                self._dispatch_update(old, ev.object)
+        elif ev.type == EventType.MODIFIED:
+            old = self.indexer.get_by_key(key)
+            self.indexer.add(ev.object)
+            self._dispatch_update(old, ev.object)
+        elif ev.type == EventType.DELETED:
+            self.indexer.delete(key)
+            self._dispatch_delete(ev.object)
+
+
+def wait_for_cache_sync(
+    stop: threading.Event, *informers: SharedIndexInformer, timeout: float = 30.0
+) -> bool:
+    """Block until every informer has replayed its initial List
+    (cache.WaitForCacheSync, k8s-operator.md:192)."""
+    deadline = time.monotonic() + timeout
+    for inf in informers:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0 or stop.is_set():
+            return False
+        if not inf._synced.wait(remaining):
+            return False
+    return True

@@ -1,0 +1,271 @@
+"""In-memory cluster state store with List/Watch — the L0 substrate.
+
+This is the apiserver-shaped object store the whole control plane runs
+against hermetically, the way the reference's operator family tests
+"multi-node" against a fake clientset serving CRUD + watch from an
+in-memory tracker (SURVEY.md §4). It implements the semantics the reference
+documents for the real apiserver:
+
+- **Optimistic concurrency**: every write bumps a store-wide monotonic
+  ``resource_version``; updates carrying a stale version fail with
+  :class:`Conflict` (the requeue-on-conflict path, SURVEY.md §7 hard part 2).
+- **Watch streams**: ``watch(kind, since_rv)`` replays buffered events after
+  ``since_rv`` then streams live — the List/Watch contract the Reflector
+  consumes (images/informer1.png at k8s-operator.md:60). A ``since_rv``
+  older than the history window raises :class:`Gone` (HTTP 410), forcing
+  the reflector to relist — exactly the real protocol.
+- **Finalizer-gated deletion**: deleting an object with finalizers only sets
+  ``metadata.deletion_timestamp``; the object is removed when a controller
+  strips the last finalizer (k8s-operator.md:36-43).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import itertools
+import queue
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+class Conflict(StoreError):
+    """Stale resource_version on update (optimistic-concurrency failure)."""
+
+
+class Gone(StoreError):
+    """Watch requested from a resource_version older than the event buffer —
+    the client must relist (HTTP 410 semantics)."""
+
+
+class EventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: EventType
+    object: Any  # a deep copy; safe to mutate
+
+    @property
+    def kind(self) -> str:
+        return self.object.kind
+
+
+_SENTINEL = object()
+
+
+class Watch:
+    """One consumer's event stream. Iterate to receive events; ``stop()``
+    ends the iteration (the stopCh analogue, k8s-operator.md:200-203)."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self._stopped = False
+
+    def _push(self, ev: WatchEvent) -> None:
+        if not self._stopped:
+            self._q.put(ev)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._q.put(_SENTINEL)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL or self._stopped:
+                return
+            yield item
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Blocking pop with timeout; None on timeout or stop."""
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is _SENTINEL:
+            return None
+        return item
+
+
+def _key(namespace: str, name: str) -> str:
+    return f"{namespace}/{name}"
+
+
+def match_labels(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class ClusterStore:
+    """Thread-safe object store keyed by (kind, namespace/name)."""
+
+    def __init__(self, history_limit: int = 4096) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        self._rv = itertools.count(1)
+        self._last_rv = 0
+        # ring buffer of (rv, WatchEvent) for replay
+        self._history: "deque[Tuple[int, WatchEvent]]" = deque(maxlen=history_limit)
+        self._watchers: List[Tuple[str, Watch]] = []
+
+    # -- internals ----------------------------------------------------------
+
+    def _bump(self) -> int:
+        self._last_rv = next(self._rv)
+        return self._last_rv
+
+    def _emit(self, etype: EventType, obj: Any) -> None:
+        ev = WatchEvent(etype, copy.deepcopy(obj))
+        self._history.append((obj.metadata.resource_version, ev))
+        for kind, w in list(self._watchers):
+            if kind == obj.kind:
+                # per-watcher copy so consumers can't race each other
+                w._push(WatchEvent(etype, copy.deepcopy(ev.object)))
+
+    def _bucket(self, kind: str) -> Dict[str, Any]:
+        return self._objects.setdefault(kind, {})
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            bucket = self._bucket(obj.kind)
+            k = obj.metadata.key
+            if k in bucket:
+                raise AlreadyExists(f"{obj.kind} {k} already exists")
+            stored = copy.deepcopy(obj)
+            stored.metadata.uid = stored.metadata.uid or uuid.uuid4().hex
+            stored.metadata.creation_timestamp = (
+                stored.metadata.creation_timestamp or time.time()
+            )
+            stored.metadata.resource_version = self._bump()
+            bucket[k] = stored
+            self._emit(EventType.ADDED, stored)
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._bucket(kind)[_key(namespace, name)])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name} not found") from None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Any], int]:
+        """Returns (items, resource_version) — the rv is the point to start
+        watching from (List-then-Watch, images/informer1.png)."""
+        with self._lock:
+            items = []
+            for obj in self._bucket(kind).values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if label_selector and not match_labels(label_selector, obj.metadata.labels):
+                    continue
+                items.append(copy.deepcopy(obj))
+            return items, self._last_rv
+
+    def update(self, obj: Any) -> Any:
+        """Write with optimistic-concurrency check. Clearing the last
+        finalizer on a deletion-marked object completes the delete."""
+        with self._lock:
+            bucket = self._bucket(obj.kind)
+            k = obj.metadata.key
+            if k not in bucket:
+                raise NotFound(f"{obj.kind} {k} not found")
+            current = bucket[k]
+            if obj.metadata.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.kind} {k}: resource_version "
+                    f"{obj.metadata.resource_version} != {current.metadata.resource_version}"
+                )
+            stored = copy.deepcopy(obj)
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+            # deletion_timestamp is set by delete(), never by clients
+            stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+            if (
+                stored.metadata.deletion_timestamp is not None
+                and not stored.metadata.finalizers
+            ):
+                del bucket[k]
+                stored.metadata.resource_version = self._bump()
+                self._emit(EventType.DELETED, stored)
+                return copy.deepcopy(stored)
+            stored.metadata.resource_version = self._bump()
+            bucket[k] = stored
+            self._emit(EventType.MODIFIED, stored)
+            return copy.deepcopy(stored)
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        """Finalizer-aware delete (k8s-operator.md:36-43): with finalizers
+        present only ``deletion_timestamp`` is set; otherwise remove."""
+        with self._lock:
+            bucket = self._bucket(kind)
+            k = _key(namespace, name)
+            if k not in bucket:
+                raise NotFound(f"{kind} {k} not found")
+            current = bucket[k]
+            if current.metadata.finalizers:
+                if current.metadata.deletion_timestamp is None:
+                    current.metadata.deletion_timestamp = time.time()
+                    current.metadata.resource_version = self._bump()
+                    self._emit(EventType.MODIFIED, current)
+                return copy.deepcopy(current)
+            del bucket[k]
+            current.metadata.resource_version = self._bump()
+            self._emit(EventType.DELETED, current)
+            return copy.deepcopy(current)
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str, since_rv: Optional[int] = None) -> Watch:
+        """Open an event stream for ``kind``. With ``since_rv``, replay
+        buffered events with rv > since_rv first; raise :class:`Gone` if the
+        buffer no longer reaches back that far."""
+        with self._lock:
+            w = Watch()
+            if since_rv is not None and since_rv < self._last_rv:
+                oldest_buffered = self._history[0][0] if self._history else None
+                if oldest_buffered is not None and since_rv < oldest_buffered - 1:
+                    raise Gone(
+                        f"resource_version {since_rv} is too old "
+                        f"(oldest buffered: {oldest_buffered})"
+                    )
+                for rv, ev in self._history:
+                    if rv > since_rv and ev.object.kind == kind:
+                        w._push(WatchEvent(ev.type, copy.deepcopy(ev.object)))
+            self._watchers.append((kind, w))
+            return w
+
+    def stop_watch(self, w: Watch) -> None:
+        with self._lock:
+            self._watchers = [(k, x) for k, x in self._watchers if x is not w]
+        w.stop()
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._last_rv

@@ -1,0 +1,142 @@
+"""Deduplicating, rate-limited work queue — SURVEY.md C16.
+
+Implements the k8s workqueue contract the sample controller relies on
+(``workqueue.NewNamedRateLimitingQueue(DefaultControllerRateLimiter(),...)``,
+k8s-operator.md:87,108):
+
+- **Dedup**: an item added while queued coalesces; an item added while
+  *being processed* is marked dirty and requeued when ``done()`` is called —
+  so one worker never processes the same key concurrently with another,
+  which is the single-writer guarantee the whole reconcile design leans on
+  (SURVEY.md §5 'Race detection').
+- **Get/Done accounting** (k8s-operator.md:155,172): every ``get()`` must be
+  paired with ``done()``.
+- **Rate limiting**: ``add_rate_limited`` applies max(per-item exponential
+  backoff, overall token bucket); ``forget`` resets an item's failure count.
+- **Shutdown**: ``shut_down()`` drains waiters; ``get()`` returns
+  ``(None, True)`` — the ``queue.ShutDown()`` path (k8s-operator.md:200-202).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Hashable, List, Optional, Set, Tuple
+
+from tfk8s_tpu.client.ratelimit import MaxOfRateLimiter, default_controller_rate_limiter
+
+
+class WorkQueue:
+    """FIFO with dedup + processing accounting."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._cond = threading.Condition()
+        self._queue: List[Hashable] = []
+        self._dirty: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._shutting_down = False
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutting_down or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # will requeue on done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Optional[Hashable], bool]:
+        """Blocks for the next item. Returns ``(item, False)`` or
+        ``(None, True)`` when shutting down (or ``(None, False)`` on
+        timeout)."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutting_down:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None, False
+                self._cond.wait(remaining)
+            if not self._queue:
+                return None, True  # shutting down and drained
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item, False
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._cond:
+            return self._shutting_down
+
+
+class DelayingQueue(WorkQueue):
+    """WorkQueue + ``add_after``: a background timer thread moves items into
+    the queue when their delay expires."""
+
+    def __init__(self, name: str = ""):
+        super().__init__(name)
+        self._heap: List[Tuple[float, int, Hashable]] = []
+        self._seq = itertools.count()
+        self._timer_cond = threading.Condition()
+        self._timer = threading.Thread(target=self._timer_loop, daemon=True)
+        self._timer.start()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._timer_cond:
+            heapq.heappush(self._heap, (time.monotonic() + delay, next(self._seq), item))
+            self._timer_cond.notify()
+
+    def _timer_loop(self) -> None:
+        while True:
+            with self._timer_cond:
+                while not self._heap:
+                    self._timer_cond.wait(0.5)
+                    if self.shutting_down and not self._heap:
+                        return
+                when, _, item = self._heap[0]
+                now = time.monotonic()
+                if when > now:
+                    self._timer_cond.wait(when - now)
+                    continue
+                heapq.heappop(self._heap)
+            self.add(item)
+
+
+class RateLimitingQueue(DelayingQueue):
+    """The ``NewNamedRateLimitingQueue`` analogue."""
+
+    def __init__(self, name: str = "", rate_limiter: Optional[MaxOfRateLimiter] = None):
+        super().__init__(name)
+        self.rate_limiter = rate_limiter or default_controller_rate_limiter()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Hashable) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self.rate_limiter.retries(item)

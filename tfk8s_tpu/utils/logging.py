@@ -1,0 +1,89 @@
+"""Structured logging for the control plane.
+
+The reference initializes logging at startup ("initlog", images/tf2.png at
+k8s-operator.md:57) and error-logs via glog (images/tf4.PNG). Here: stdlib
+logging with one configuration point, plus a structured event recorder the
+controller uses for observability (SURVEY.md §5 'Metrics / logging').
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
+_configured = False
+
+
+def init_logging(level: int = logging.INFO) -> None:
+    """The 'initlog' step of startup (images/tf2.png)."""
+    global _configured
+    if not _configured:
+        logging.basicConfig(level=level, format=_FORMAT)
+        _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"tfk8s.{name}")
+
+
+@dataclasses.dataclass
+class Event:
+    """One structured control-plane event (job created, gang admitted,
+    pod failed, ...)."""
+
+    timestamp: float
+    kind: str
+    key: str  # namespace/name of the involved object
+    reason: str
+    message: str = ""
+
+
+class EventRecorder:
+    """Append-only in-memory event log; tests and the CLI 'describe' read it."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._events: List[Event] = []
+        self._capacity = capacity
+
+    def event(self, kind: str, key: str, reason: str, message: str = "") -> None:
+        ev = Event(time.time(), kind, key, reason, message)
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > self._capacity:
+                self._events = self._events[-self._capacity :]
+        get_logger("events").info("%s %s %s %s", kind, key, reason, message)
+
+    def events(self, key: Optional[str] = None, reason: Optional[str] = None) -> List[Event]:
+        with self._lock:
+            return [
+                e
+                for e in self._events
+                if (key is None or e.key == key) and (reason is None or e.reason == reason)
+            ]
+
+
+class Metrics:
+    """Minimal counter/gauge registry (SURVEY.md §5: 'no metrics endpoint
+    evidenced' in the reference — this is the build's addition)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"counters": dict(self.counters), "gauges": dict(self.gauges)}
