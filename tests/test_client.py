@@ -282,12 +282,11 @@ def test_informer_relist_delivers_gap_deletes():
     inf.add_event_handler(
         ResourceEventHandler(on_delete=lambda o: deletes.append(o))
     )
-    # Break the watch: delete behind the informer's back via a raw store with
-    # tiny history, forcing Gone on reconnect.
+    # Break the watch with a wrapper that 410s once and deletes 'goes'
+    # *inside* the recovery list — deterministically inside the watch gap.
     inf._client = _GoneOnceLW(jc)
     if inf._watch:
         inf._watch.stop()  # force reconnect
-    jc.delete("goes")
     deadline = time.time() + 5
     while not deletes and time.time() < deadline:
         time.sleep(0.01)
@@ -297,7 +296,9 @@ def test_informer_relist_delivers_gap_deletes():
 
 
 class _GoneOnceLW:
-    """ListWatch wrapper whose first watch() raises Gone (simulated 410)."""
+    """ListWatch wrapper: first watch() raises Gone (simulated 410); the
+    recovery list() then deletes 'goes' before listing, guaranteeing the
+    object vanishes inside the watch gap."""
 
     def __init__(self, inner):
         self._inner = inner
@@ -305,6 +306,11 @@ class _GoneOnceLW:
         self.kind = inner.kind
 
     def list(self):
+        if self._raised:
+            try:
+                self._inner.delete("goes")
+            except Exception:
+                pass
         return self._inner.list()
 
     def watch(self, since_rv=None):

@@ -1,0 +1,278 @@
+"""Hermetic end-to-end tests: TPUJob submitted to the fake cluster,
+reconciled by the real controller, executed by the local kubelet — the
+full §3.2/§3.3/§3.4/§3.5 loop with zero TPUs (SURVEY.md §7 'minimum
+end-to-end slice').
+"""
+
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import (
+    CleanPodPolicy,
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodPhase,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    helpers,
+)
+from tfk8s_tpu.api.types import SchedulingPolicy, RunPolicy
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.trainer import FINALIZER, SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer import labels as L
+
+RESULTS = {}
+
+
+@registry.register("test.echo")
+def _echo(env):
+    RESULTS[env["TFK8S_JOB_NAME"] + "/" + env["TFK8S_PROCESS_ID"]] = dict(env)
+    time.sleep(0.02)
+
+
+@registry.register("test.block-until-stopped")
+def _block(env, stop):
+    stop.wait(10)
+
+
+def make_job(name, workers=1, entrypoint="test.echo", accelerator="cpu-1", gang=True, **env):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ContainerSpec(entrypoint=entrypoint, env=dict(env)),
+                )
+            },
+            tpu=TPUSpec(accelerator=accelerator),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=gang)),
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    """Controller + kubelet running against one fake cluster."""
+    cs = FakeClientset()
+    allocator = SliceAllocator({"v5litepod-16": 2})
+    ctrl = TPUJobController(cs, allocator=allocator)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, stop
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def get_job(cs, name):
+    return cs.tpujobs().get(name)
+
+
+def job_has(cs, name, ctype):
+    try:
+        return helpers.has_condition(get_job(cs, name).status, ctype)
+    except NotFound:
+        return False
+
+
+def test_single_worker_job_runs_to_succeeded(cluster):
+    cs, ctrl, stop = cluster
+    cs.tpujobs().create(make_job("echo1"))
+    assert wait_for(lambda: job_has(cs, "echo1", JobConditionType.SUCCEEDED))
+    job = get_job(cs, "echo1")
+    assert job.status.replica_statuses[ReplicaType.WORKER].succeeded == 1
+    assert job.status.completion_time is not None
+    # the entrypoint saw the coordination contract
+    env = RESULTS["echo1/0"]
+    assert env["TFK8S_NUM_PROCESSES"] == "1"
+    assert env["TFK8S_COORDINATOR_ADDRESS"].startswith("echo1-worker-0")
+    assert env["TFK8S_SLICE_ID"].startswith("cpu/")
+    # completed pod is KEPT (k8s-operator.md:50-52; CleanPodPolicy=Running)
+    assert cs.pods().get("echo1-worker-0").status.phase == PodPhase.SUCCEEDED
+
+
+def test_multi_worker_gang_all_env_consistent(cluster):
+    cs, ctrl, stop = cluster
+    cs.tpujobs().create(make_job("gang4", workers=4))
+    assert wait_for(lambda: job_has(cs, "gang4", JobConditionType.SUCCEEDED))
+    envs = [RESULTS[f"gang4/{i}"] for i in range(4)]
+    assert {e["TFK8S_PROCESS_ID"] for e in envs} == {"0", "1", "2", "3"}
+    assert len({e["TFK8S_COORDINATOR_ADDRESS"] for e in envs}) == 1
+    assert all(e["TFK8S_NUM_PROCESSES"] == "4" for e in envs)
+
+
+def test_job_reaches_running_then_teardown_honors_finalizer(cluster):
+    cs, ctrl, stop = cluster
+    cs.tpujobs().create(make_job("longrun", entrypoint="test.block-until-stopped"))
+    assert wait_for(lambda: job_has(cs, "longrun", JobConditionType.RUNNING))
+    job = get_job(cs, "longrun")
+    assert FINALIZER in job.metadata.finalizers
+    assert job.status.start_time is not None
+    # delete: finalizer teardown must remove pods, then the job itself
+    cs.tpujobs().delete("longrun")
+
+    def job_gone():
+        try:
+            get_job(cs, "longrun")
+            return False
+        except NotFound:
+            return True
+
+    assert wait_for(job_gone)
+    pods, _ = cs.pods().list(label_selector=L.job_selector("longrun"))
+    assert pods == []
+
+
+def test_gang_restart_from_failure_then_success(cluster):
+    """A pod failure in gang mode restarts the whole gang; the job then
+    succeeds, with gang_restarts recorded — SURVEY.md §2 elastic semantics."""
+    cs, ctrl, stop = cluster
+    cs.tpujobs().create(
+        make_job("flaky", workers=2, TFK8S_TEST_FAIL_TIMES="1")
+    )
+    assert wait_for(lambda: job_has(cs, "flaky", JobConditionType.SUCCEEDED), timeout=20)
+    job = get_job(cs, "flaky")
+    assert job.status.gang_restarts >= 1
+    assert any(e.reason == "GangRestart" for e in ctrl.recorder.events())
+
+
+def test_backoff_limit_fails_job(cluster):
+    cs, ctrl, stop = cluster
+    j = make_job("doomed", TFK8S_TEST_FAIL_TIMES="99")
+    j.spec.run_policy.backoff_limit = 1
+    cs.tpujobs().create(j)
+    assert wait_for(lambda: job_has(cs, "doomed", JobConditionType.FAILED), timeout=20)
+    job = get_job(cs, "doomed")
+    cond = helpers.get_condition(job.status, JobConditionType.FAILED)
+    assert cond.reason == "BackoffLimitExceeded"
+
+
+def test_restart_policy_never_fails_fast(cluster):
+    cs, ctrl, stop = cluster
+    j = make_job("never", TFK8S_TEST_FAIL_TIMES="99", gang=False)
+    j.spec.replica_specs[ReplicaType.WORKER].restart_policy = __import__(
+        "tfk8s_tpu.api.types", fromlist=["RestartPolicy"]
+    ).RestartPolicy.NEVER
+    cs.tpujobs().create(j)
+    assert wait_for(lambda: job_has(cs, "never", JobConditionType.FAILED))
+    cond = helpers.get_condition(get_job(cs, "never").status, JobConditionType.FAILED)
+    assert cond.reason == "PodFailed"
+    # the failed pod is kept for inspection (k8s-operator.md:47-52)
+    assert cs.pods().get("never-worker-0").status.phase == PodPhase.FAILED
+
+
+def test_per_pod_restart_in_nongang_mode(cluster):
+    cs, ctrl, stop = cluster
+    j = make_job("podrestart", TFK8S_TEST_FAIL_TIMES="1", gang=False)
+    cs.tpujobs().create(j)
+    assert wait_for(lambda: job_has(cs, "podrestart", JobConditionType.SUCCEEDED), timeout=20)
+    assert any(e.reason == "PodRestart" for e in ctrl.recorder.events())
+    job = get_job(cs, "podrestart")
+    assert job.status.gang_restarts == 0  # per-pod, not gang
+
+
+def test_invalid_spec_fails_without_pods(cluster):
+    cs, ctrl, stop = cluster
+    bad = make_job("badjob", accelerator="warp-drive")
+    cs.tpujobs().create(bad)
+    assert wait_for(lambda: job_has(cs, "badjob", JobConditionType.FAILED))
+    cond = helpers.get_condition(get_job(cs, "badjob").status, JobConditionType.FAILED)
+    assert cond.reason == "ValidationFailed"
+    pods, _ = cs.pods().list(label_selector=L.job_selector("badjob"))
+    assert pods == []
+
+
+def test_gang_admission_blocks_until_capacity_frees(cluster):
+    """All-or-nothing admission: two v5litepod-16 jobs fit (2 slices), the
+    third waits until one finishes — SURVEY.md §7 hard part 1."""
+    cs, ctrl, stop = cluster
+
+    def tpu_job(name):
+        # v5litepod-16 = 4 hosts -> 4 workers
+        return make_job(
+            name, workers=4, entrypoint="test.block-until-stopped",
+            accelerator="v5litepod-16",
+        )
+
+    cs.tpujobs().create(tpu_job("slice-a"))
+    cs.tpujobs().create(tpu_job("slice-b"))
+    assert wait_for(lambda: job_has(cs, "slice-a", JobConditionType.RUNNING))
+    assert wait_for(lambda: job_has(cs, "slice-b", JobConditionType.RUNNING))
+    cs.tpujobs().create(tpu_job("slice-c"))
+    assert wait_for(
+        lambda: any(e.reason == "GangPending" for e in ctrl.recorder.events())
+    )
+    # no partial pod creation for the pending gang
+    pods, _ = cs.pods().list(label_selector=L.job_selector("slice-c"))
+    assert pods == []
+    # finish job A -> capacity frees -> C admitted
+    cs.tpujobs().delete("slice-a")
+    assert wait_for(lambda: job_has(cs, "slice-c", JobConditionType.RUNNING), timeout=20)
+
+
+def test_job_invalidated_after_admission_releases_gang(cluster):
+    """A spec edited into invalidity while running must still tear down
+    pods and return its slices to the pool."""
+    cs, ctrl, stop = cluster
+    cs.tpujobs().create(
+        make_job("mutate", workers=4, entrypoint="test.block-until-stopped",
+                 accelerator="v5litepod-16")
+    )
+    assert wait_for(lambda: job_has(cs, "mutate", JobConditionType.RUNNING))
+    assert ctrl.allocator.free_slices("v5litepod-16") == 1
+    j = get_job(cs, "mutate")
+    j.spec.tpu.accelerator = "warp-drive"
+    cs.tpujobs().update(j)
+    assert wait_for(lambda: job_has(cs, "mutate", JobConditionType.FAILED))
+    assert wait_for(lambda: ctrl.allocator.free_slices("v5litepod-16") == 2)
+    assert wait_for(
+        lambda: all(
+            p.status.phase != PodPhase.RUNNING
+            for p in cs.pods().list(label_selector=L.job_selector("mutate"))[0]
+        )
+    )
+
+
+def test_clean_pod_policy_all_removes_everything(cluster):
+    cs, ctrl, stop = cluster
+    j = make_job("cleanall")
+    j.spec.run_policy.clean_pod_policy = CleanPodPolicy.ALL
+    cs.tpujobs().create(j)
+    assert wait_for(lambda: job_has(cs, "cleanall", JobConditionType.SUCCEEDED))
+    assert wait_for(
+        lambda: cs.pods().list(label_selector=L.job_selector("cleanall"))[0] == []
+    )
+
+
+def test_ttl_deletes_finished_job(cluster):
+    cs, ctrl, stop = cluster
+    j = make_job("ttl-job")
+    j.spec.run_policy.ttl_seconds_after_finished = 0.3
+    cs.tpujobs().create(j)
+
+    def job_gone():
+        try:
+            get_job(cs, "ttl-job")
+            return False
+        except NotFound:
+            return True
+
+    assert wait_for(job_gone, timeout=20)

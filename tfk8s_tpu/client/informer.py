@@ -154,29 +154,52 @@ class SharedIndexInformer:
     def _dispatch_add(self, obj: Any) -> None:
         for h in list(self._handlers):
             if h.on_add:
-                h.on_add(copy.deepcopy(obj))
+                self._guard(h.on_add, copy.deepcopy(obj))
 
     def _dispatch_update(self, old: Any, new: Any) -> None:
         for h in list(self._handlers):
             if h.on_update:
-                h.on_update(copy.deepcopy(old) if old is not None else None, copy.deepcopy(new))
+                self._guard(
+                    h.on_update,
+                    copy.deepcopy(old) if old is not None else None,
+                    copy.deepcopy(new),
+                )
 
     def _dispatch_delete(self, obj: Any) -> None:
         for h in list(self._handlers):
             if h.on_delete:
-                h.on_delete(copy.deepcopy(obj) if not isinstance(obj, DeletedFinalStateUnknown) else obj)
+                self._guard(
+                    h.on_delete,
+                    copy.deepcopy(obj) if not isinstance(obj, DeletedFinalStateUnknown) else obj,
+                )
+
+    def _guard(self, fn, *args) -> None:
+        # A handler exception must not kill the reflector (which would force
+        # a relist storm); handlers are not this thread's code.
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001
+            log.exception("%s: event handler raised", self.name)
 
     # -- reflector ----------------------------------------------------------
 
     def _list_and_sync(self) -> int:
         """Initial (or recovery) List: replace the cache, emit synthetic
-        events for the diff, return the rv to watch from."""
+        events for the diff, return the rv to watch from. Objects already
+        cached are delivered as updates (old, new) — not as adds — so
+        update filters keep working across relists; objects that vanished
+        during a watch gap are delivered as DeletedFinalStateUnknown."""
         items, rv = self._client.list()
+        old_objs = {k: self.indexer.get_by_key(k) for k in self.indexer.keys()}
         displaced = self.indexer.replace(items)
         for obj in displaced:
             self._dispatch_delete(DeletedFinalStateUnknown(meta_namespace_key(obj), obj))
         for obj in items:
-            self._dispatch_add(obj)
+            old = old_objs.get(meta_namespace_key(obj))
+            if old is None:
+                self._dispatch_add(obj)
+            else:
+                self._dispatch_update(old, obj)
         return rv
 
     def _reflector_loop(self) -> None:

@@ -46,8 +46,10 @@ class Controller:
         max_retries: int = 15,
         recorder: Optional[EventRecorder] = None,
         metrics: Optional[Metrics] = None,
+        kind: str = "",
     ):
         self.name = name
+        self.kind = kind or name
         self.sync = sync
         self.informers = list(informers)
         self.queue = RateLimitingQueue(name)
@@ -146,7 +148,7 @@ class Controller:
                         self.name, key, retries, traceback.format_exc(),
                     )
                     self.recorder.event(
-                        "TPUJob", key, "SyncDropped", f"gave up after {retries} retries: {e}"
+                        self.kind, key, "SyncDropped", f"gave up after {retries} retries: {e}"
                     )
                     self.queue.forget(key)
             else:

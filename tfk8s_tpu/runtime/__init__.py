@@ -1,0 +1,7 @@
+"""Data-plane runtime: entrypoint registry, local kubelet (hermetic node
+agent), JAX distributed launcher, mesh construction, train loop, and
+checkpointing (SURVEY.md §7 step 5).
+"""
+
+from tfk8s_tpu.runtime.kubelet import LocalKubelet  # noqa: F401
+from tfk8s_tpu.runtime import registry  # noqa: F401

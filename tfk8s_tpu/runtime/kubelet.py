@@ -1,0 +1,149 @@
+"""LocalKubelet: executes pods in-process — the node agent of the hermetic
+backend.
+
+In the reference's world the kubelet pulls the image and starts the
+container, which is the control->data plane handoff (SURVEY.md §3.3,
+'PROCESS+MACHINE BOUNDARY'). Here each pod's entrypoint runs on a thread:
+the kubelet claims Pending pods from the watch, flips them to Running,
+invokes the entrypoint with the pod's env (the JAX coordination contract),
+and records Succeeded/Failed with the exit message — which flows back
+through the watch into the controller's reconcile, closing the loop of
+SURVEY.md §3.5.
+
+Failure injection for tests: an env of ``TFK8S_TEST_FAIL_TIMES=n`` makes a
+pod raise on its first n attempts per pod name (counted in-process), which
+exercises restart policies end-to-end.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, Optional
+
+from tfk8s_tpu.api.types import Pod, PodPhase
+from tfk8s_tpu.client.clientset import Clientset
+from tfk8s_tpu.client.informer import ResourceEventHandler, SharedIndexInformer
+from tfk8s_tpu.client.store import Conflict, NotFound
+from tfk8s_tpu.runtime import registry
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("kubelet")
+
+
+class LocalKubelet:
+    """Watches pods and runs their entrypoints on daemon threads."""
+
+    def __init__(self, clientset: Clientset, name: str = "local-kubelet"):
+        self.cs = clientset
+        self.name = name
+        self.informer = SharedIndexInformer(clientset.pods(namespace=None), name="kubelet-pod")
+        self.informer.add_event_handler(
+            ResourceEventHandler(
+                on_add=self._maybe_run,
+                on_update=self._on_update,
+                on_delete=self._on_delete,
+            )
+        )
+        self._claimed: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._stop: Optional[threading.Event] = None
+        self._fail_counts: Dict[str, int] = {}
+
+    def run(self, stop: threading.Event) -> None:
+        self._stop = stop
+        self.informer.run(stop)
+
+    # -- pod lifecycle ------------------------------------------------------
+
+    def _on_update(self, old: Pod, new: Pod) -> None:
+        if new.metadata.deletion_timestamp is not None:
+            self._signal_stop(new.metadata.key)
+        else:
+            self._maybe_run(new)
+
+    def _on_delete(self, obj) -> None:
+        # Deletion is how the controller stops a pod (gang restart,
+        # teardown): signal the entrypoint's stop event so the old trainer
+        # exits instead of running concurrently with its replacement.
+        meta = getattr(obj, "obj", obj).metadata  # unwrap DeletedFinalStateUnknown
+        self._signal_stop(meta.key)
+
+    def _signal_stop(self, key: str) -> None:
+        with self._lock:
+            evs = [ev for (k, _uid), ev in self._claimed.items() if k == key]
+        for ev in evs:
+            ev.set()
+
+    def _maybe_run(self, pod: Pod) -> None:
+        if pod.status.phase != PodPhase.PENDING:
+            return
+        # Claims are keyed by (key, uid): a recreated pod reuses its name but
+        # gets a fresh uid, so it is a new claim even if the old thread is
+        # still draining.
+        claim = (pod.metadata.key, pod.metadata.uid)
+        with self._lock:
+            if claim in self._claimed:
+                return
+            pod_stop = threading.Event()
+            self._claimed[claim] = pod_stop
+        t = threading.Thread(
+            target=self._run_pod, args=(pod, pod_stop), name=f"pod-{pod.metadata.name}",
+            daemon=True,
+        )
+        t.start()
+
+    def _set_phase(self, pod_key: str, uid: str, phase: PodPhase, message: str = "", exit_code=None) -> bool:
+        ns, name = pod_key.split("/", 1)
+        for _ in range(5):
+            try:
+                current = self.cs.pods(ns).get(name)
+            except NotFound:
+                return False
+            if current.metadata.uid != uid:
+                return False  # a successor pod took this name; not ours
+            current.status.phase = phase
+            current.status.message = message
+            current.status.exit_code = exit_code
+            current.status.host = self.name
+            try:
+                self.cs.pods(ns).update_status(current)
+                return True
+            except Conflict:
+                continue
+            except NotFound:
+                return False
+        log.warning("%s: giving up updating %s to %s", self.name, pod_key, phase)
+        return False
+
+    def _run_pod(self, pod: Pod, pod_stop: threading.Event) -> None:
+        key, uid = pod.metadata.key, pod.metadata.uid
+        try:
+            container = pod.spec.containers[0]
+            env = dict(container.env)
+            # test-only failure injection
+            fail_times = int(env.get("TFK8S_TEST_FAIL_TIMES", "0"))
+            if not self._set_phase(key, uid, PodPhase.RUNNING):
+                return
+            if fail_times:
+                with self._lock:
+                    n = self._fail_counts.get(pod.metadata.name, 0)
+                    self._fail_counts[pod.metadata.name] = n + 1
+                if n < fail_times:
+                    raise RuntimeError(f"injected failure {n + 1}/{fail_times}")
+            fn = registry.resolve(container.entrypoint)
+            registry.call(fn, env, pod_stop)
+            self._set_phase(key, uid, PodPhase.SUCCEEDED, exit_code=0)
+        except Exception as e:  # noqa: BLE001 — container failure, not ours
+            log.info("%s: pod %s failed: %s", self.name, key, e)
+            self._set_phase(
+                key,
+                uid,
+                PodPhase.FAILED,
+                message=f"{type(e).__name__}: {e}",
+                exit_code=1,
+            )
+            log.debug("%s", traceback.format_exc())
+        finally:
+            with self._lock:
+                self._claimed.pop((key, uid), None)

@@ -1,0 +1,8 @@
+"""L3 trainer layer (SURVEY.md C18/C19 + gang scheduling): renders a TPUJob
+into gang-admitted, topology-placed replica pods/services carrying the JAX
+coordination contract, and the TPUJob controller that reconciles them.
+"""
+
+from tfk8s_tpu.trainer.gang import GangAssignment, SliceAllocator, SliceHandle  # noqa: F401
+from tfk8s_tpu.trainer.tpujob_controller import FINALIZER, TPUJobController  # noqa: F401
+from tfk8s_tpu.trainer import labels, replicas  # noqa: F401

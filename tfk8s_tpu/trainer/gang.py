@@ -1,0 +1,129 @@
+"""Gang scheduling: all-or-nothing admission of a job's replicas onto
+ICI-contiguous TPU slices.
+
+The reference has no equivalent — k8s Jobs admit pods independently
+(k8s-operator.md:44-49) and a partially-scheduled TF cluster just wedges.
+On TPU the hardware forces the issue: a slice exists or it doesn't, and a
+job's mesh spans whole slices. This module is the SURVEY.md §7 hard-part-1
+answer: a slice inventory + atomic admission, so the controller either gets
+every host of every slice it needs or nothing, and slice loss releases the
+whole gang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from tfk8s_tpu.api.types import TPUJob
+from tfk8s_tpu.utils import topology as topo
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("gang")
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceHandle:
+    """One physical slice in the inventory."""
+
+    slice_id: str
+    accelerator: str
+    info: topo.SliceInfo
+
+
+@dataclasses.dataclass
+class GangAssignment:
+    """Result of admission: which slices a job got, and the host layout.
+    ``host_of(process_id)`` maps a job process to (slice_id, host_index)."""
+
+    job_uid: str
+    slices: List[SliceHandle]
+    hosts_per_slice: int
+
+    def host_of(self, process_id: int) -> tuple:
+        s, h = divmod(process_id, self.hosts_per_slice)
+        return self.slices[s].slice_id, h
+
+    @property
+    def total_hosts(self) -> int:
+        return len(self.slices) * self.hosts_per_slice
+
+
+class SliceAllocator:
+    """Inventory of slices by accelerator type with atomic gang admission.
+
+    ``capacity`` maps accelerator type -> number of identical slices the
+    cluster owns (e.g. ``{"v5p-32": 4}``). ``cpu-*`` accelerators are
+    treated as unlimited local capacity (the hermetic backend)."""
+
+    def __init__(self, capacity: Optional[Dict[str, int]] = None):
+        self._lock = threading.Lock()
+        self._free: Dict[str, List[SliceHandle]] = {}
+        self._assigned: Dict[str, GangAssignment] = {}
+        self._cpu_counter = 0
+        for acc, n in (capacity or {}).items():
+            info = topo.parse_accelerator(acc)
+            self._free[info.accelerator] = [
+                SliceHandle(f"{info.accelerator}/slice-{i}", info.accelerator, info)
+                for i in range(n)
+            ]
+
+    def admit(self, job: TPUJob) -> Optional[GangAssignment]:
+        """All-or-nothing: returns an assignment of ``num_slices`` whole
+        slices, or None if capacity is short. Idempotent per job uid."""
+        uid = job.metadata.uid
+        with self._lock:
+            if uid in self._assigned:
+                return self._assigned[uid]
+            info = topo.parse_accelerator(job.spec.tpu.accelerator, job.spec.tpu.topology)
+            want = max(job.spec.tpu.num_slices, 1)
+            if info.generation == "cpu":
+                # Local/hermetic backend: slices are virtual and unlimited,
+                # and every replica is a "host" of its virtual slice (cpu
+                # jobs aren't bound by physical host counts — validation
+                # exempts them too).
+                from tfk8s_tpu.api import helpers as _h
+
+                total = max(_h.total_replicas(job), 1)
+                hosts_per_slice = -(-total // want)  # ceil div
+                handles = []
+                for _ in range(want):
+                    handles.append(
+                        SliceHandle(f"cpu/slice-{self._cpu_counter}", info.accelerator, info)
+                    )
+                    self._cpu_counter += 1
+                ga = GangAssignment(uid, handles, hosts_per_slice=hosts_per_slice)
+                self._assigned[uid] = ga
+                return ga
+            free = self._free.get(info.accelerator, [])
+            if len(free) < want:
+                return None
+            handles = [free.pop() for _ in range(want)]
+            ga = GangAssignment(uid, handles, hosts_per_slice=info.hosts)
+            self._assigned[uid] = ga
+            log.info(
+                "admitted job uid=%s onto %s", uid, [h.slice_id for h in handles]
+            )
+            return ga
+
+    def assignment(self, job_uid: str) -> Optional[GangAssignment]:
+        with self._lock:
+            return self._assigned.get(job_uid)
+
+    def release(self, job_uid: str) -> None:
+        """Return a gang's slices to the pool (job finished, deleted, or
+        gang-restarting after slice loss)."""
+        with self._lock:
+            ga = self._assigned.pop(job_uid, None)
+            if ga is None:
+                return
+            for h in ga.slices:
+                if not h.slice_id.startswith("cpu/"):
+                    self._free.setdefault(h.accelerator, []).append(h)
+            log.info("released gang of job uid=%s", job_uid)
+
+    def free_slices(self, accelerator: str) -> int:
+        with self._lock:
+            info = topo.parse_accelerator(accelerator)
+            return len(self._free.get(info.accelerator, []))

@@ -1,0 +1,486 @@
+"""The TPUJob controller: level-triggered reconcile of TPUJob -> gang of
+pods/services (SURVEY.md C15 + C18 joined, re-designed for TPU gang
+semantics).
+
+Reconcile contract (idempotent; every step safe to repeat — SURVEY.md §7
+hard part 2):
+
+1. key -> cache lookup; a missing object means 'deleted' -> release the
+   gang (k8s-operator.md:162-164).
+2. ``deletion_timestamp`` set -> finalizer logic: tear down replicas,
+   release slices, strip the finalizer so the store completes the delete
+   (k8s-operator.md:36-43; SURVEY.md §3.4).
+3. default + validate; invalid specs -> Failed(ValidationFailed).
+4. finished jobs -> clean-pod policy + TTL; completed pods are *kept*
+   unless policy says otherwise (k8s-operator.md:50-52).
+5. gang admission (all-or-nothing, SURVEY.md §7 hard part 1); short
+   capacity -> requeue with event, optional admission timeout -> Failed.
+6. create missing pods/services (level-triggered: compares desired vs
+   observed, never assumes its own last write survived).
+7. failure handling (k8s-operator.md:47-49 translated to slices):
+   - gang mode (TPU): any failed pod -> whole-gang restart-from-checkpoint
+     while ``backoff_limit`` lasts, then Failed;
+   - per-pod mode (cpu/hermetic, gang=False): OnFailure/Always restart the
+     task in place up to ``max_restarts``; Never -> replacement pods are
+     NOT created, job fails (the reference's Never-vs-OnFailure split).
+8. status: replica counts, Created/Running/Succeeded/Failed conditions,
+   ``active_deadline_seconds`` enforcement.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from tfk8s_tpu.api import helpers, serde, set_defaults, validate
+from tfk8s_tpu.api.types import (
+    CleanPodPolicy,
+    JobConditionType,
+    Pod,
+    PodPhase,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+)
+from tfk8s_tpu.client.clientset import Clientset
+from tfk8s_tpu.client.informer import SharedIndexInformer, ResourceEventHandler
+from tfk8s_tpu.client.listers import Lister
+from tfk8s_tpu.client.store import AlreadyExists, Conflict, NotFound
+from tfk8s_tpu.controller.controller import Controller
+from tfk8s_tpu.trainer import labels as L
+from tfk8s_tpu.trainer import replicas as R
+from tfk8s_tpu.trainer.gang import SliceAllocator
+from tfk8s_tpu.utils.logging import EventRecorder, Metrics, get_logger
+
+log = get_logger("tpujob")
+
+FINALIZER = "tfk8s.dev/job-cleanup"
+RESTARTS_ANNOTATION = "tfk8s.dev/restarts"
+PENDING_REQUEUE_S = 0.5
+
+
+class TPUJobController:
+    """Owns the TPUJob/Pod/Service informers and the reconcile logic."""
+
+    def __init__(
+        self,
+        clientset: Clientset,
+        allocator: Optional[SliceAllocator] = None,
+        recorder: Optional[EventRecorder] = None,
+        metrics: Optional[Metrics] = None,
+        resync_period: float = 0.0,
+    ):
+        self.cs = clientset
+        self.allocator = allocator or SliceAllocator()
+        self.recorder = recorder or EventRecorder()
+        self.metrics = metrics or Metrics()
+
+        self.job_informer = SharedIndexInformer(
+            clientset.tpujobs(namespace=None), resync_period, name="tpujob"
+        )
+        self.pod_informer = SharedIndexInformer(
+            clientset.pods(namespace=None), resync_period, name="pod"
+        )
+        self.svc_informer = SharedIndexInformer(
+            clientset.services(namespace=None), resync_period, name="service"
+        )
+        self.jobs = Lister(self.job_informer.indexer, "TPUJob")
+        self.pods = Lister(self.pod_informer.indexer, "Pod")
+        self.services = Lister(self.svc_informer.indexer, "Service")
+
+        self.controller = Controller(
+            "tpujob",
+            self.sync,
+            informers=[self.job_informer, self.pod_informer, self.svc_informer],
+            recorder=self.recorder,
+            metrics=self.metrics,
+            kind="TPUJob",
+        )
+        self.job_informer.add_event_handler(self.controller.default_handler())
+        # Pod/Service events reconcile their owning job (the enqueuePod
+        # pattern of k8s-operator.md:132-139, re-keyed to the owner).
+        owner_handler = ResourceEventHandler(
+            on_add=self._enqueue_owner,
+            on_update=lambda old, new: self._enqueue_owner(new),
+            on_delete=self._enqueue_owner,
+        )
+        self.pod_informer.add_event_handler(owner_handler)
+        self.svc_informer.add_event_handler(owner_handler)
+        # gang release needs the uid after the job object is gone
+        self._uid_by_key: dict = {}
+        # pod name -> restart count to stamp on the next recreation
+        self._pending_restart_counts: dict = {}
+
+    def _enqueue_owner(self, obj) -> None:
+        meta = getattr(obj, "obj", obj).metadata  # unwrap DeletedFinalStateUnknown
+        job_name = meta.labels.get(L.JOB_NAME)
+        if job_name:
+            self.controller.enqueue_key(f"{meta.namespace}/{job_name}")
+
+    def run(self, workers: int, stop, block: bool = True) -> bool:
+        return self.controller.run(workers, stop, block=block)
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        job = self.jobs.get_by_key(key)
+        if job is None:
+            # Object gone from cache: release any gang it held
+            uid = self._uid_by_key.pop(key, None)
+            if uid:
+                self.allocator.release(uid)
+            return
+
+        if job.metadata.deletion_timestamp is not None:
+            self._finalize(job)
+            return
+
+        job = set_defaults(serde.roundtrip(job))  # work on a defaulted copy
+        errs = validate(job)
+        if errs:
+            if helpers.set_condition(
+                job.status,
+                JobConditionType.FAILED,
+                reason="ValidationFailed",
+                message="; ".join(errs),
+            ):
+                self.recorder.event("TPUJob", key, "ValidationFailed", "; ".join(errs))
+                self._write_status(job)
+            # A job can become invalid *after* admission (spec edited while
+            # running): still tear down and release its slices.
+            self._cleanup_finished(job)
+            return
+
+        self._uid_by_key[key] = job.metadata.uid
+
+        if helpers.is_finished(job.status):
+            self._cleanup_finished(job)
+            return
+
+        # Ensure our finalizer before creating anything it must clean up.
+        if FINALIZER not in job.metadata.finalizers:
+            job.metadata.finalizers.append(FINALIZER)
+            self.cs.tpujobs(ns).update(job)
+            return  # updated object re-enqueues via the watch
+
+        changed = helpers.set_condition(
+            job.status, JobConditionType.CREATED, reason="JobCreated"
+        )
+        if changed:
+            self.recorder.event("TPUJob", key, "JobCreated")
+
+        # Gang admission (SURVEY.md §7 hard part 1)
+        ga = self.allocator.admit(job)
+        if ga is None:
+            self.recorder.event(
+                "TPUJob", key, "GangPending",
+                f"insufficient capacity for {job.spec.tpu.accelerator} "
+                f"x{job.spec.tpu.num_slices}",
+            )
+            self.metrics.inc("tpujob.gang_pending")
+            timeout = job.spec.run_policy.scheduling.admission_timeout_s
+            created = helpers.get_condition(job.status, JobConditionType.CREATED)
+            if timeout and created and time.time() - created.last_transition_time > timeout:
+                helpers.set_condition(
+                    job.status, JobConditionType.FAILED,
+                    reason="AdmissionTimeout",
+                    message=f"gang not admitted within {timeout}s",
+                )
+                self._write_status(job)
+                return
+            if changed:
+                self._write_status(job)
+            self.controller.enqueue_after(key, PENDING_REQUEUE_S)
+            return
+
+        # Deadline enforcement
+        rp = job.spec.run_policy
+        if (
+            rp.active_deadline_seconds
+            and job.status.start_time
+            and time.time() - job.status.start_time > rp.active_deadline_seconds
+        ):
+            helpers.set_condition(
+                job.status, JobConditionType.FAILED,
+                reason="DeadlineExceeded",
+                message=f"active for more than {rp.active_deadline_seconds}s",
+            )
+            self.recorder.event("TPUJob", key, "DeadlineExceeded")
+            self._delete_job_pods(job, only_phases=None)
+            self._write_status(job)
+            return
+
+        self._reconcile_replicas(job, ga, status_changed=changed)
+
+    # ------------------------------------------------------- replica logic
+
+    def _observed_pods(self, job: TPUJob) -> List[Pod]:
+        return self.pods.list(job.metadata.namespace, L.job_selector(job.metadata.name))
+
+    def _reconcile_replicas(self, job: TPUJob, ga, status_changed: bool) -> None:
+        ns, key = job.metadata.namespace, job.metadata.key
+        desired_pods, desired_svcs = R.render_all(job, ga)
+        desired_names = {p.metadata.name for p in desired_pods}
+        desired_svc_names = {s.metadata.name for s in desired_svcs}
+        observed = {p.metadata.name: p for p in self._observed_pods(job)}
+        observed_svcs = {
+            s.metadata.name
+            for s in self.services.list(ns, L.job_selector(job.metadata.name))
+        }
+
+        # Orphans (scale-down or stale template): delete pods AND services.
+        for pname, pod in observed.items():
+            if pname not in desired_names and pod.metadata.deletion_timestamp is None:
+                self._delete_pod(ns, pname)
+        for sname in observed_svcs - desired_svc_names:
+            try:
+                self.cs.services(ns).delete(sname)
+            except NotFound:
+                pass
+
+        # Failure accounting before creation, so a gang restart deletes
+        # pods instead of racing recreation.
+        failed = [
+            p for p in observed.values()
+            if p.status.phase == PodPhase.FAILED and p.metadata.name in desired_names
+        ]
+        if failed and self._handle_failures(job, failed, observed):
+            return  # terminal or gang-restarting; next events continue
+
+        for svc in desired_svcs:
+            if svc.metadata.name not in observed_svcs:
+                try:
+                    self.cs.services(ns).create(svc)
+                except AlreadyExists:
+                    pass
+        for pod in desired_pods:
+            existing = observed.get(pod.metadata.name)
+            if existing is None:
+                # preserve restart lineage across in-place restarts
+                restarts = self._pending_restart_counts.pop(pod.metadata.key, None)
+                if restarts is not None:
+                    pod.metadata.annotations[RESTARTS_ANNOTATION] = str(restarts)
+                try:
+                    self.cs.pods(ns).create(pod)
+                    self.metrics.inc("tpujob.pods_created")
+                except AlreadyExists:
+                    pass
+
+        self._update_job_status(job, status_changed)
+
+    def _handle_failures(self, job: TPUJob, failed: List[Pod], observed) -> bool:
+        """Returns True when reconcile should stop (terminal / restarting)."""
+        key = job.metadata.key
+        ns = job.metadata.namespace
+        gang_mode = job.spec.run_policy.scheduling.gang
+
+        # Replica-level policy: Never means a failure is permanent.
+        for pod in failed:
+            if pod.spec.restart_policy == RestartPolicy.NEVER:
+                helpers.set_condition(
+                    job.status, JobConditionType.FAILED,
+                    reason="PodFailed",
+                    message=f"pod {pod.metadata.name} failed: {pod.status.message}",
+                )
+                self.recorder.event("TPUJob", key, "PodFailed", pod.metadata.name)
+                self._write_status(job)
+                return True
+
+        if gang_mode:
+            # Slice loss is gang loss: restart everything from checkpoint
+            # (SURVEY.md §2 'Elastic / gang semantics').
+            limit = job.spec.run_policy.backoff_limit or 0
+            if job.status.gang_restarts >= limit:
+                helpers.set_condition(
+                    job.status, JobConditionType.FAILED,
+                    reason="BackoffLimitExceeded",
+                    message=f"gang restarted {job.status.gang_restarts}x; limit {limit}",
+                )
+                self.recorder.event("TPUJob", key, "BackoffLimitExceeded")
+                self._write_status(job)
+                return True
+            job.status.gang_restarts += 1
+            helpers.set_condition(
+                job.status, JobConditionType.RESTARTING,
+                reason="GangRestart",
+                message=f"restart {job.status.gang_restarts} after "
+                f"{[p.metadata.name for p in failed]} failed",
+            )
+            self.recorder.event(
+                "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
+            )
+            self.metrics.inc("tpujob.gang_restarts")
+            self._delete_job_pods(job, only_phases=None)
+            self._write_status(job)
+            return True
+
+        # Per-pod in-place restart (OnFailure/Always/ExitCode)
+        for pod in failed:
+            restarts = int(pod.metadata.annotations.get(RESTARTS_ANNOTATION, "0"))
+            rspec = None
+            rt = pod.metadata.labels.get(L.REPLICA_TYPE)
+            if rt:
+                rspec = job.spec.replica_specs.get(ReplicaType(rt))
+            max_restarts = rspec.max_restarts if rspec else 0
+            if restarts >= (max_restarts or 0):
+                helpers.set_condition(
+                    job.status, JobConditionType.FAILED,
+                    reason="BackoffLimitExceeded",
+                    message=f"pod {pod.metadata.name} failed {restarts + 1}x",
+                )
+                self._write_status(job)
+                return True
+            self._delete_pod(ns, pod.metadata.name)
+            self.recorder.event(
+                "TPUJob", key, "PodRestart",
+                f"{pod.metadata.name} restart #{restarts + 1}",
+            )
+            # The recreated pod inherits the incremented restart count
+            # (keyed by namespace/name so same-named jobs in different
+            # namespaces can't cross-contaminate lineage).
+            self._pending_restart_counts[pod.metadata.key] = restarts + 1
+        return False
+
+    def _delete_pod(self, ns: str, name: str) -> None:
+        try:
+            self.cs.pods(ns).delete(name)
+            self.metrics.inc("tpujob.pods_deleted")
+        except NotFound:
+            pass
+
+    def _delete_job_pods(self, job: TPUJob, only_phases) -> None:
+        for p in self._observed_pods(job):
+            if only_phases is None or p.status.phase in only_phases:
+                self._delete_pod(job.metadata.namespace, p.metadata.name)
+
+    # ----------------------------------------------------------- status
+
+    def _update_job_status(self, job: TPUJob, already_changed: bool) -> None:
+        key = job.metadata.key
+        observed = self._observed_pods(job)
+        changed = already_changed
+
+        new_statuses = {}
+        for rt in helpers.sorted_replica_types(job):
+            rs = ReplicaStatus()
+            for p in observed:
+                if p.metadata.labels.get(L.REPLICA_TYPE) != rt.value:
+                    continue
+                if p.status.phase in (PodPhase.PENDING, PodPhase.SCHEDULED, PodPhase.RUNNING):
+                    rs.active += 1
+                elif p.status.phase == PodPhase.SUCCEEDED:
+                    rs.succeeded += 1
+                elif p.status.phase == PodPhase.FAILED:
+                    rs.failed += 1
+                rs.restarts += int(p.metadata.annotations.get(RESTARTS_ANNOTATION, "0"))
+            new_statuses[rt] = rs
+        if new_statuses != job.status.replica_statuses:
+            job.status.replica_statuses = new_statuses
+            changed = True
+
+        # Success: every compute replica ran to completion (chief acts as
+        # the completion oracle when present).
+        compute_types = [
+            rt for rt in (ReplicaType.CHIEF, ReplicaType.WORKER)
+            if rt in job.spec.replica_specs
+        ]
+        def _count(rt):
+            return job.spec.replica_specs[rt].replicas or 0
+
+        if ReplicaType.CHIEF in compute_types:
+            done = new_statuses[ReplicaType.CHIEF].succeeded >= _count(ReplicaType.CHIEF)
+        else:
+            done = all(new_statuses[rt].succeeded >= _count(rt) for rt in compute_types)
+
+        n_active = sum(rs.active for rs in new_statuses.values())
+        n_expected = helpers.total_replicas(job)
+
+        if done:
+            if helpers.set_condition(
+                job.status, JobConditionType.SUCCEEDED, reason="JobSucceeded"
+            ):
+                job.status.completion_time = time.time()
+                self.recorder.event("TPUJob", key, "JobSucceeded")
+                self.metrics.inc("tpujob.succeeded")
+                changed = True
+            self.allocator.release(job.metadata.uid)
+        elif n_active == n_expected and n_expected > 0:
+            running = all(
+                p.status.phase == PodPhase.RUNNING for p in observed
+                if p.metadata.labels.get(L.REPLICA_TYPE)
+            )
+            if running:
+                if job.status.start_time is None:
+                    job.status.start_time = time.time()
+                    changed = True
+                if helpers.set_condition(
+                    job.status, JobConditionType.RUNNING, reason="AllReplicasRunning"
+                ):
+                    self.recorder.event("TPUJob", key, "JobRunning")
+                    changed = True
+
+        if changed:
+            self._write_status(job)
+
+    def _write_status(self, job: TPUJob) -> None:
+        try:
+            self.cs.tpujobs(job.metadata.namespace).update_status(job)
+        except Conflict:
+            # Stale copy: the watch will deliver the fresh object and the
+            # controller re-enqueues — the canonical conflict path.
+            self.controller.enqueue_key(job.metadata.key)
+        except NotFound:
+            pass
+
+    # ------------------------------------------------------ teardown paths
+
+    def _cleanup_finished(self, job: TPUJob) -> None:
+        """Clean-pod policy + TTL for finished jobs; slices are returned to
+        the pool either way."""
+        self.allocator.release(job.metadata.uid)
+        policy = job.spec.run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
+        if policy == CleanPodPolicy.ALL:
+            self._delete_job_pods(job, only_phases=None)
+            self._delete_job_services(job)
+        elif policy == CleanPodPolicy.RUNNING:
+            self._delete_job_pods(
+                job, only_phases=(PodPhase.PENDING, PodPhase.SCHEDULED, PodPhase.RUNNING)
+            )
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and job.status.completion_time:
+            age = time.time() - job.status.completion_time
+            if age >= ttl:
+                try:
+                    self.cs.tpujobs(job.metadata.namespace).delete(job.metadata.name)
+                except NotFound:
+                    pass
+            else:
+                self.controller.enqueue_after(job.metadata.key, ttl - age + 0.05)
+
+    def _delete_job_services(self, job: TPUJob) -> None:
+        for s in self.services.list(
+            job.metadata.namespace, L.job_selector(job.metadata.name)
+        ):
+            try:
+                self.cs.services(job.metadata.namespace).delete(s.metadata.name)
+            except NotFound:
+                pass
+
+    def _finalize(self, job: TPUJob) -> None:
+        """Deletion path (SURVEY.md §3.4): tear everything down, then strip
+        the finalizer so the store completes the delete."""
+        key = job.metadata.key
+        self._delete_job_pods(job, only_phases=None)
+        self._delete_job_services(job)
+        self.allocator.release(job.metadata.uid)
+        if FINALIZER in job.metadata.finalizers:
+            job.metadata.finalizers.remove(FINALIZER)
+            try:
+                self.cs.tpujobs(job.metadata.namespace).update(job)
+            except Conflict:
+                self.controller.enqueue_key(key)
+            except NotFound:
+                return
+        self.recorder.event("TPUJob", key, "JobDeleted")
