@@ -1,15 +1,24 @@
 """Test configuration: force the JAX CPU backend with 8 virtual devices so
 multi-chip sharding is exercised hermetically, the way the reference tests
 its reconcile loop against a fake clientset instead of a cluster
-(SURVEY.md §4). Must run before anything imports jax.
+(SURVEY.md §4).
+
+Note: the axon TPU environment imports jax from sitecustomize at
+interpreter startup, so JAX_PLATFORMS is already latched — the platform
+must be overridden via jax.config, and XLA_FLAGS set before first backend
+initialization (which has not happened yet at conftest time).
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

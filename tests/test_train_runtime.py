@@ -1,0 +1,176 @@
+"""Data-plane tests on the 8-device virtual CPU mesh: mesh/sharding
+plumbing, the sharded train loop, checkpoint/resume, and MNIST-MLP
+convergence — standalone and through the full control plane (the complete
+SURVEY.md §7 'minimum end-to-end slice')."""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tfk8s_tpu.models import mlp
+from tfk8s_tpu.parallel import MeshConfig, logical_to_mesh_axes, make_mesh, params_shardings
+from tfk8s_tpu.runtime.train import TrainConfig, Trainer, run_task
+
+
+def test_virtual_mesh_has_8_devices():
+    assert jax.device_count() == 8  # conftest forces the virtual CPU mesh
+
+
+def test_mesh_config_canonical_order_and_build():
+    cfg = MeshConfig.create(tensor=2, data=4)
+    assert cfg.names == ("data", "tensor")  # canonical order, not call order
+    mesh = cfg.build()
+    assert mesh.shape == {"data": 4, "tensor": 2}
+
+
+def test_mesh_from_env_contract():
+    cfg = MeshConfig.from_env({"TFK8S_MESH": json.dumps({"data": 2, "tensor": 4})})
+    assert cfg.shape == (2, 4)
+
+
+def test_mesh_too_big_rejected():
+    with pytest.raises(ValueError):
+        MeshConfig.create(data=16).build()
+
+
+def test_logical_rules_drop_missing_axes():
+    mesh = make_mesh(data=8)
+    # "mlp" maps to tensor, which this mesh lacks -> replicated
+    spec = logical_to_mesh_axes(("embed", "mlp"), mesh=mesh)
+    assert spec == P(None, None)
+    mesh2 = make_mesh(data=4, tensor=2)
+    assert logical_to_mesh_axes(("embed", "mlp"), mesh=mesh2) == P(None, "tensor")
+
+
+def test_param_shardings_from_flax_metadata():
+    mesh = make_mesh(data=4, tensor=2)
+    task = mlp.make_task()
+    boxed = jax.eval_shape(task.init, jax.random.key(0))
+    shardings = params_shardings(boxed, mesh)
+    fc1 = shardings["fc1"]["kernel"]
+    # ("embed","mlp") -> (fsdp, tensor); fsdp absent -> (None, "tensor")
+    assert fc1.spec == P(None, "tensor")
+    assert shardings["fc1"]["bias"].spec == P()
+
+
+def _quick_cfg(steps=60, **kw):
+    return TrainConfig(steps=steps, learning_rate=3e-3, log_every=steps, **kw)
+
+
+def test_mlp_trains_on_data_parallel_mesh():
+    mesh = make_mesh(data=8)
+    trainer = Trainer(mlp.make_task(batch_size=64), _quick_cfg(200), mesh)
+    state, history = trainer.fit()
+    assert history[-1]["accuracy"] > 0.8
+    assert history[-1]["loss"] < history[0]["loss"] if len(history) > 1 else True
+    # params actually sharded? fc1 kernel replicated here (no tensor axis),
+    # but the state must live on all 8 devices
+    assert int(state.step) == 200
+
+
+def test_mlp_trains_identically_shaped_on_dp_tp_mesh():
+    """Same model, dp x tp mesh: kernels shard over tensor; loss still
+    falls — the GSPMD path exercised end to end on 8 virtual devices."""
+    mesh = make_mesh(data=2, fsdp=2, tensor=2)
+    trainer = Trainer(mlp.make_task(batch_size=64), _quick_cfg(100), mesh)
+    state, history = trainer.fit()
+    assert history[-1]["accuracy"] > 0.5
+    fc1 = state.params["fc1"]["kernel"]
+    spec = fc1.sharding.spec
+    assert tuple(spec) == ("fsdp", "tensor")
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    mesh = make_mesh(data=8)
+    task = mlp.make_task(batch_size=64)
+    cfg = _quick_cfg(40, checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=20)
+    trainer = Trainer(task, cfg, mesh)
+    state, _ = trainer.fit()
+    assert int(state.step) == 40
+
+    cfg2 = _quick_cfg(60, checkpoint_dir=str(tmp_path / "ckpt"), resume=True)
+    trainer2 = Trainer(task, cfg2, mesh)
+    state2, history2 = trainer2.fit()
+    # resumed from 40, not 0
+    assert int(state2.step) == 60
+    assert history2[-1]["step"] == 60
+
+
+def test_run_task_env_contract_and_targets():
+    env = {
+        "TFK8S_TRAIN_STEPS": "200",
+        "TFK8S_LEARNING_RATE": "3e-3",
+        "TFK8S_MESH": json.dumps({"data": 8}),
+    }
+    final = run_task(mlp.make_task(), env)
+    assert final["accuracy"] >= 0.9  # targets enforced inside run_task too
+
+
+def test_run_task_raises_on_missed_target():
+    task = mlp.make_task(batch_size=32)
+    task.targets = {"accuracy": 0.999}
+    with pytest.raises(RuntimeError, match="missed target"):
+        run_task(task, {"TFK8S_TRAIN_STEPS": "5"})
+
+
+# --- the full stack: MNIST TPUJob through controller + kubelet --------------
+
+
+def test_mnist_tpujob_end_to_end():
+    """BASELINE configs[0]: a single-worker MNIST job submitted to the fake
+    cluster trains to target accuracy and the job transitions to Succeeded
+    — every layer of SURVEY.md §1 with zero TPUs."""
+    from tfk8s_tpu.api import (
+        ContainerSpec,
+        JobConditionType,
+        ObjectMeta,
+        ReplicaSpec,
+        ReplicaType,
+        TPUJob,
+        TPUJobSpec,
+        helpers,
+    )
+    from tfk8s_tpu.client import FakeClientset
+    from tfk8s_tpu.runtime import LocalKubelet
+    from tfk8s_tpu.trainer import TPUJobController
+
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+
+    job = TPUJob(
+        metadata=ObjectMeta(name="mnist"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=ContainerSpec(
+                        entrypoint="tfk8s_tpu.models.mlp:train",
+                        env={"TFK8S_TRAIN_STEPS": "250", "TFK8S_LEARNING_RATE": "3e-3"},
+                    ),
+                )
+            },
+        ),
+    )
+    cs.tpujobs().create(job)
+    deadline = time.time() + 120
+    succeeded = False
+    while time.time() < deadline:
+        j = cs.tpujobs().get("mnist")
+        if helpers.is_succeeded(j.status):
+            succeeded = True
+            break
+        if helpers.is_failed(j.status):
+            pytest.fail(f"job failed: {[c.message for c in j.status.conditions]}")
+        time.sleep(0.1)
+    assert succeeded, "MNIST job did not converge within deadline"
+    stop.set()
+    ctrl.controller.shutdown()

@@ -1,0 +1,5 @@
+"""Model families (SURVEY.md §7 step 6, BASELINE.json config order):
+MNIST MLP, ResNet-50, BERT-base MLM, T5-base seq2seq, DLRM/Wide&Deep.
+Each exposes ``make_task()`` (a runtime TrainTask) and a ``train`` TPUJob
+entrypoint.
+"""

@@ -1,0 +1,22 @@
+"""Parallelism layer: mesh axes, logical sharding rules, collectives
+(SURVEY.md §2 parallelism + communication-backend accounting).
+"""
+
+from tfk8s_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshConfig,
+    make_mesh,
+)
+from tfk8s_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_to_mesh_axes,
+    named_sharding,
+    params_shardings,
+    shard_constraint,
+    unbox,
+)

@@ -1,0 +1,128 @@
+"""Device-mesh construction: the data plane's parallelism foundation.
+
+The reference's only parallelism construct is the PS/WORKER cluster spec —
+process-level partition with gRPC transport (k8s-operator.md:6; SURVEY.md
+§2 parallelism table). The TPU-native design replaces all of it with one
+object: a ``jax.sharding.Mesh`` whose named axes carry every strategy —
+
+- ``data``      pure data parallelism (batch sharding; DP row of the table)
+- ``fsdp``      data parallelism with parameter sharding (the dense-PS
+                replacement: parameters live sharded, gathered on use)
+- ``expert``    expert parallelism for MoE (EP row)
+- ``pipeline``  pipeline stages over DCN (PP row)
+- ``sequence``  sequence/context parallelism (SP/ring-attention row)
+- ``tensor``    tensor parallelism (TP row; innermost — wants the
+                fastest ICI hops)
+
+Axis order is canonical: later axes vary fastest over the device list, so
+``tensor`` neighbors are ICI-adjacent and ``data``/``pipeline`` span the
+slower (DCN/multislice) dimension — the scaling-book layout recipe.
+
+XLA's GSPMD emits the collectives (all-reduce/all-gather/reduce-scatter/
+all-to-all/collective-permute) from sharding annotations; no user-level
+communication library exists anywhere in this framework (SURVEY.md §2
+'Distributed communication backend').
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
+AXIS_PIPELINE = "pipeline"
+AXIS_SEQUENCE = "sequence"
+AXIS_TENSOR = "tensor"
+
+# Slowest-varying -> fastest-varying over the device list.
+CANONICAL_ORDER: Tuple[str, ...] = (
+    AXIS_PIPELINE,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Validated, canonically-ordered logical mesh axes."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def create(cls, **sizes: int) -> "MeshConfig":
+        """``MeshConfig.create(data=4, tensor=2)`` — unknown axis names are
+        allowed but ordered after the canonical ones, in call order."""
+        ordered: List[Tuple[str, int]] = []
+        for name in CANONICAL_ORDER:
+            if name in sizes and sizes[name] > 1:
+                ordered.append((name, sizes[name]))
+        for name, size in sizes.items():
+            if name not in CANONICAL_ORDER and size > 1:
+                ordered.append((name, size))
+        if not ordered:
+            ordered = [(AXIS_DATA, 1)]
+        return cls(tuple(ordered))
+
+    @classmethod
+    def from_dict(cls, axes: Dict[str, int]) -> "MeshConfig":
+        return cls.create(**axes)
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "MeshConfig":
+        """Build from the trainer contract's ``TFK8S_MESH`` env var
+        (trainer/replicas.py)."""
+        env = os.environ if env is None else env
+        raw = env.get("TFK8S_MESH", "")
+        if raw:
+            return cls.from_dict(json.loads(raw))
+        return cls.create(data=jax.device_count())
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+    def axis_size(self, name: str) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return s
+        return 1
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Reshape the device list into the canonical grid. With fewer
+        requested devices than available, uses a prefix (handy for tests)."""
+        devices = list(jax.devices()) if devices is None else list(devices)
+        n = self.size()
+        if n > len(devices):
+            raise ValueError(
+                f"mesh {dict(self.axes)} needs {n} devices; {len(devices)} available"
+            )
+        grid = np.array(devices[:n], dtype=object).reshape(self.shape)
+        return Mesh(grid, self.names)
+
+
+def make_mesh(devices: Optional[Sequence] = None, **sizes: int) -> Mesh:
+    """One-call convenience: ``make_mesh(data=2, tensor=4)``."""
+    return MeshConfig.create(**sizes).build(devices)
+
+
+def single_device_mesh() -> Mesh:
+    return MeshConfig.create(data=1).build()

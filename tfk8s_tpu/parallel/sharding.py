@@ -1,0 +1,129 @@
+"""Logical-axis sharding rules: how model dimensions map onto mesh axes.
+
+Models annotate parameters/activations with *logical* axis names ("embed",
+"heads", "batch", ...) via ``flax.linen.with_partitioning`` /
+``nn.with_logical_constraint``; these rules translate them to mesh axes, and
+GSPMD turns the result into collectives over ICI. This replaces both halves
+of the reference's PS/WORKER split (k8s-operator.md:6): parameters are
+*sharded by annotation* (fsdp/tensor) rather than pushed to parameter-server
+processes, and gradients all-reduce over ``data`` rather than via gRPC.
+
+The rule set follows the Megatron/t5x convention: attention heads and MLP
+hidden shard over ``tensor``; embedding/vocab over ``tensor``; the embed
+(model) dimension of every kernel shards over ``fsdp`` when FSDP is on;
+batch shards over ``data``+``fsdp``; sequence over ``sequence``; experts
+over ``expert``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from flax import linen as nn
+from flax.core import meta as flax_meta
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfk8s_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+# (logical axis, mesh axis/axes or None)
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", (AXIS_DATA, AXIS_FSDP)),
+    ("seq", AXIS_SEQUENCE),
+    ("embed", AXIS_FSDP),
+    ("heads", AXIS_TENSOR),
+    ("kv", None),
+    ("mlp", AXIS_TENSOR),
+    ("vocab", AXIS_TENSOR),
+    ("expert", AXIS_EXPERT),
+    ("expert_mlp", AXIS_TENSOR),
+    ("stack", None),
+    ("norm", None),
+)
+
+
+def logical_to_mesh_axes(
+    logical: Sequence[Optional[str]],
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+    mesh: Optional[Mesh] = None,
+) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec,
+    dropping mesh axes the mesh doesn't have (so the same model runs on a
+    data-only mesh and a dp+tp mesh unchanged)."""
+    table = dict(rules)
+    available = set(mesh.axis_names) if mesh is not None else None
+    used = set()
+    out = []
+    for name in logical:
+        axis = table.get(name) if name is not None else None
+        if axis is None:
+            out.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        kept = tuple(
+            a for a in axes
+            if (available is None or a in available) and a not in used
+        )
+        used.update(kept)
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(kept)
+    return P(*out)
+
+
+def named_sharding(
+    mesh: Mesh,
+    *logical: Optional[str],
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh_axes(logical, rules, mesh))
+
+
+def shard_constraint(
+    x: jax.Array,
+    mesh: Mesh,
+    *logical: Optional[str],
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+) -> jax.Array:
+    """``with_sharding_constraint`` by logical names — activations keep
+    their layout through the jitted step without manual PartitionSpecs."""
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, *logical, rules=rules)
+    )
+
+
+def params_shardings(
+    params: Any,
+    mesh: Mesh,
+    rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES,
+) -> Any:
+    """Tree of NamedShardings for a variable tree whose leaves carry flax
+    ``Partitioned`` metadata (from ``nn.with_partitioning``). Unannotated
+    leaves are fully replicated."""
+
+    def one(leaf):
+        if isinstance(leaf, flax_meta.Partitioned):
+            return named_sharding(mesh, *leaf.names, rules=rules)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(
+        one, params, is_leaf=lambda x: isinstance(x, flax_meta.Partitioned)
+    )
+
+
+def unbox(tree: Any) -> Any:
+    """Strip flax Partitioned boxes, keeping raw arrays."""
+    return flax_meta.unbox(tree)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,100 @@
+"""Worker-side launcher: the data plane's entry contract.
+
+Decodes the env the trainer rendered into each pod (trainer/replicas.py) —
+the TPU-native replacement for TF_CONFIG (SURVEY.md §3.3): instead of a TF
+runtime reading ``{cluster, job, task_index}`` and starting gRPC servers,
+each pod runs ``jax.distributed.initialize`` against the coordinator
+service, attaches to its slice's chips, and builds the job's logical mesh.
+
+Hermetic mode (cpu accelerators / single process) skips distributed init
+and uses the host's (possibly virtual) devices — the same code path the
+tests and the local kubelet exercise, per the fake-backed test philosophy
+of SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+import jax
+
+from tfk8s_tpu.parallel.mesh import MeshConfig
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("launcher")
+
+
+@dataclasses.dataclass
+class ProcessContext:
+    """Everything a training process learns from its pod env."""
+
+    job_name: str = "local"
+    namespace: str = "default"
+    replica_type: str = "Worker"
+    replica_index: int = 0
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: str = ""
+    accelerator: str = ""
+    num_slices: int = 1
+    slice_id: str = ""
+    host_index: int = 0
+    gang_restarts: int = 0
+    checkpoint_dir: str = ""
+    mesh: Optional[MeshConfig] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "ProcessContext":
+        e = dict(os.environ) if env is None else env
+        mesh = MeshConfig.from_env(e) if "TFK8S_MESH" in e else None
+        return cls(
+            job_name=e.get("TFK8S_JOB_NAME", "local"),
+            namespace=e.get("TFK8S_NAMESPACE", "default"),
+            replica_type=e.get("TFK8S_REPLICA_TYPE", "Worker"),
+            replica_index=int(e.get("TFK8S_REPLICA_INDEX", "0")),
+            process_id=int(e.get("TFK8S_PROCESS_ID", "0")),
+            num_processes=int(e.get("TFK8S_NUM_PROCESSES", "1")),
+            coordinator_address=e.get("TFK8S_COORDINATOR_ADDRESS", ""),
+            accelerator=e.get("TFK8S_ACCELERATOR", ""),
+            num_slices=int(e.get("TFK8S_NUM_SLICES", "1")),
+            slice_id=e.get("TFK8S_SLICE_ID", ""),
+            host_index=int(e.get("TFK8S_HOST_INDEX", "0")),
+            gang_restarts=int(e.get("TFK8S_GANG_RESTARTS", "0")),
+            checkpoint_dir=e.get("TFK8S_CHECKPOINT_DIR", ""),
+            mesh=mesh,
+        )
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def resuming(self) -> bool:
+        """True on a gang restart — the process must restore from the last
+        checkpoint (SURVEY.md §5 checkpoint/resume contract)."""
+        return self.gang_restarts > 0
+
+
+def initialize_distributed(ctx: ProcessContext, env: Optional[Dict[str, str]] = None) -> None:
+    """Real multi-host path: one JAX process per TPU VM host. Gated on
+    ``TFK8S_DISTRIBUTED=1`` so hermetic in-process runs (threads sharing one
+    JAX runtime) never try to bind coordination ports."""
+    e = dict(os.environ) if env is None else env
+    if ctx.num_processes <= 1 or e.get("TFK8S_DISTRIBUTED") != "1":
+        return
+    log.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
+        ctx.coordinator_address, ctx.num_processes, ctx.process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=ctx.coordinator_address,
+        num_processes=ctx.num_processes,
+        process_id=ctx.process_id,
+    )
+
+
+def build_mesh(ctx: ProcessContext):
+    cfg = ctx.mesh or MeshConfig.create(data=jax.device_count())
+    return cfg.build()

@@ -1,0 +1,281 @@
+"""The sharded training loop: TrainTask -> pjit'd steps over a mesh.
+
+This is the data plane the reference never had (its operator treats the TF
+runtime as a black box, k8s-operator.md:6; SURVEY.md L0). Design rules, per
+the TPU execution model:
+
+- ONE jitted train step, traced once: optimizer update fused with the
+  backward pass; no data-dependent Python control flow inside.
+- Shardings are explicit at the jit boundary (``in_shardings`` /
+  ``out_shardings`` from the task's logical-axis annotations), so GSPMD
+  emits all collectives — gradient all-reduce over ``data`` rides ICI
+  exactly as the north star prescribes (BASELINE.json).
+- The step donates the state buffer (params/opt-state update in place —
+  HBM is the budget).
+- Host work per step is one synthetic-batch build + ``device_put`` with the
+  batch sharding; everything else stays on device.
+
+``run_task`` is the glue entrypoints use: env contract -> mesh -> optional
+checkpoint restore (gang restart) -> fit -> final metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tfk8s_tpu.parallel import sharding as shd
+from tfk8s_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, MeshConfig
+from tfk8s_tpu.runtime.checkpoint import Checkpointer
+from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("train")
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+@dataclasses.dataclass
+class TrainTask:
+    """What a model family provides to be trainable (SURVEY.md §7 step 6).
+
+    ``init`` returns a flax variable tree whose leaves may carry
+    ``Partitioned`` metadata; ``loss_fn(params, batch, rng) -> (loss, aux)``
+    computes the scalar objective; ``make_batch(np_rng, batch_size)``
+    produces one host-side synthetic batch (hermetic: no dataset I/O)."""
+
+    name: str
+    init: Callable[[jax.Array], Any]
+    loss_fn: Callable[[Any, Any, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
+    make_batch: Callable[[np.random.Generator, int], Any]
+    batch_size: int = 32
+    rules: Sequence[Tuple[str, Any]] = shd.DEFAULT_RULES
+    # metric name -> target the run should reach (convergence check)
+    targets: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    steps: int = 100
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    warmup_steps: int = 0
+    log_every: int = 20
+    checkpoint_every: int = 0
+    checkpoint_dir: str = ""
+    seed: int = 0
+    resume: bool = False
+    optimizer: Optional[optax.GradientTransformation] = None
+
+    def make_optimizer(self) -> optax.GradientTransformation:
+        if self.optimizer is not None:
+            return self.optimizer
+        if self.warmup_steps > 0:
+            sched = optax.linear_schedule(0.0, self.learning_rate, self.warmup_steps)
+        else:
+            sched = self.learning_rate
+        return optax.adamw(sched, weight_decay=self.weight_decay)
+
+
+def _suffix_match_shardings(abstract_tree, params_paths, mesh):
+    """Sharding tree for an optimizer state: leaves whose (path-suffix,
+    shape) match a parameter reuse that parameter's sharding (adam's mu/nu
+    mirror the param tree); everything else is replicated."""
+
+    def one(path, leaf):
+        key = tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        shape = getattr(leaf, "shape", None)
+        for ppath, (psharding, pshape) in params_paths.items():
+            if shape == pshape and key[-len(ppath):] == ppath:
+                return psharding
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(one, abstract_tree)
+
+
+class Trainer:
+    def __init__(self, task: TrainTask, config: TrainConfig, mesh: Mesh):
+        self.task = task
+        self.config = config
+        self.mesh = mesh
+        self.optimizer = config.make_optimizer()
+        self._build()
+
+    # -- sharding/jit plumbing ---------------------------------------------
+
+    def _build(self) -> None:
+        task, mesh = self.task, self.mesh
+        rng = jax.random.key(self.config.seed)
+
+        boxed_abstract = jax.eval_shape(task.init, rng)
+        self.param_shardings = shd.params_shardings(boxed_abstract, mesh, task.rules)
+        abstract_params = shd.unbox(boxed_abstract)
+
+        # path -> (sharding, shape), for matching optimizer-state leaves
+        flat = jax.tree_util.tree_flatten_with_path(abstract_params)[0]
+        flat_sh = jax.tree_util.tree_flatten_with_path(self.param_shardings)[0]
+        params_paths = {}
+        for (path, leaf), (_, s) in zip(flat, flat_sh):
+            key = tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            params_paths[key] = (s, leaf.shape)
+
+        abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
+        self.opt_shardings = _suffix_match_shardings(abstract_opt, params_paths, mesh)
+        self.state_shardings = TrainState(
+            step=NamedSharding(mesh, P()),
+            params=self.param_shardings,
+            opt_state=self.opt_shardings,
+        )
+
+        def _init(r) -> TrainState:
+            params = shd.unbox(task.init(r))
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.optimizer.init(params),
+            )
+
+        self._init_fn = jax.jit(_init, out_shardings=self.state_shardings)
+
+        def _step(state: TrainState, batch, r):
+            def loss_fn(p):
+                return task.loss_fn(p, batch, r)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
+            return (
+                TrainState(step=state.step + 1, params=new_params, opt_state=new_opt),
+                metrics,
+            )
+
+        self._step_fn = jax.jit(
+            _step,
+            in_shardings=(self.state_shardings, self._batch_shardings(), None),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    def _batch_shardings(self):
+        """Batch leaves shard dim 0 over data(+fsdp); scalars replicate."""
+        example = self.task.make_batch(np.random.default_rng(0), self.task.batch_size)
+
+        def one(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim == 0:
+                return NamedSharding(self.mesh, P())
+            axes = tuple(
+                a for a in (AXIS_DATA, AXIS_FSDP) if a in self.mesh.axis_names
+            )
+            if not axes:
+                return NamedSharding(self.mesh, P())
+            return NamedSharding(
+                self.mesh, P(axes if len(axes) > 1 else axes[0], *([None] * (arr.ndim - 1)))
+            )
+
+        self._example_batch = example
+        return jax.tree_util.tree_map(one, example)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init_state(self) -> TrainState:
+        return self._init_fn(jax.random.key(self.config.seed))
+
+    def fit(
+        self,
+        state: Optional[TrainState] = None,
+        stop: Optional[Any] = None,  # threading.Event-like graceful preemption
+    ) -> Tuple[TrainState, List[Dict[str, float]]]:
+        cfg = self.config
+        ckpt = Checkpointer(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
+
+        if state is None:
+            state = self.init_state()
+            if cfg.resume and ckpt and ckpt.enabled and ckpt.latest_step() is not None:
+                state = ckpt.restore(state)
+                log.info("%s: resumed at step %d", self.task.name, int(state.step))
+
+        np_rng = np.random.default_rng(cfg.seed + int(state.step))
+        history: List[Dict[str, float]] = []
+        start_step = int(state.step)
+        batch_shardings = self._batch_shardings()
+
+        t0 = time.perf_counter()
+        for step in range(start_step, cfg.steps):
+            if stop is not None and getattr(stop, "is_set", lambda: False)():
+                log.info("%s: stop requested at step %d", self.task.name, step)
+                break
+            host_batch = self.task.make_batch(np_rng, self.task.batch_size)
+            batch = jax.device_put(host_batch, batch_shardings)
+            state, metrics = self._step_fn(state, batch, jax.random.fold_in(jax.random.key(cfg.seed), step))
+            if ckpt and cfg.checkpoint_every and (step + 1) % cfg.checkpoint_every == 0:
+                ckpt.save(step + 1, state)
+            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                m = {k: float(v) for k, v in metrics.items()}
+                m["step"] = step + 1
+                m["steps_per_s"] = (step + 1 - start_step) / (time.perf_counter() - t0)
+                history.append(m)
+                log.info(
+                    "%s step %d: %s", self.task.name, step + 1,
+                    {k: round(v, 4) for k, v in m.items()},
+                )
+        if ckpt and ckpt.enabled:
+            ckpt.save(int(state.step), state, wait=True)
+            ckpt.close()
+        return state, history
+
+
+def run_task(
+    task: TrainTask,
+    env: Optional[Dict[str, str]] = None,
+    stop: Optional[Any] = None,
+    config: Optional[TrainConfig] = None,
+) -> Dict[str, float]:
+    """Entrypoint glue: env contract -> mesh -> (resume ->) fit -> metrics.
+    Raises if the task declares convergence targets and misses them — a
+    failed pod is how the control plane learns training went wrong
+    (SURVEY.md §3.5)."""
+    env = dict(env or {})
+    ctx = ProcessContext.from_env(env)
+    initialize_distributed(ctx, env)
+    mesh = build_mesh(ctx)
+
+    if config is None:
+        config = TrainConfig(
+            steps=int(env.get("TFK8S_TRAIN_STEPS", "100")),
+            learning_rate=float(env.get("TFK8S_LEARNING_RATE", "1e-3")),
+            log_every=int(env.get("TFK8S_LOG_EVERY", "20")),
+            checkpoint_every=int(env.get("TFK8S_CHECKPOINT_EVERY", "0")),
+            checkpoint_dir=ctx.checkpoint_dir,
+            seed=int(env.get("TFK8S_SEED", "0")),
+            resume=ctx.resuming,
+        )
+
+    trainer = Trainer(task, config, mesh)
+    state, history = trainer.fit(stop=stop)
+    final = history[-1] if history else {}
+    for metric, target in task.targets.items():
+        got = final.get(metric)
+        if got is None:
+            raise RuntimeError(f"{task.name}: target metric {metric!r} was never reported")
+        # loss-like metrics must go below target; accuracy-like above
+        ok = got <= target if "loss" in metric else got >= target
+        if not ok:
+            raise RuntimeError(
+                f"{task.name}: {metric}={got:.4f} missed target {target} "
+                f"after {final.get('step')} steps"
+            )
+    return final
