@@ -1,0 +1,101 @@
+"""Headline benchmark: ResNet-50 images/sec/chip (BASELINE.json "metric").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (`BASELINE.json "published": {}`,
+SURVEY.md §6), so ``vs_baseline`` compares against the last recorded run
+of *this* repo (BENCH_BASELINE.json, committed after each round) — 1.0 on
+the first measurement.
+
+Runs on whatever backend JAX finds: the driver runs it on the one real
+TPU chip; set BENCH_SMALL=1 for a seconds-scale CPU smoke run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfk8s_tpu.models import resnet
+    from tfk8s_tpu.parallel.mesh import make_mesh
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    if small:
+        task = resnet.make_task(
+            depth=18, num_classes=8, image_size=32, batch_size=8, width=8
+        )
+        steps, warmup = 8, 3
+    else:
+        task = resnet.make_task(
+            depth=50,
+            num_classes=1000,
+            image_size=224,
+            batch_size=int(os.environ.get("BENCH_BATCH", "128")),
+        )
+        steps, warmup = 30, 10
+
+    n_chips = jax.device_count()
+    mesh = make_mesh(data=n_chips)
+    trainer = Trainer(task, TrainConfig(steps=steps, learning_rate=1e-3), mesh)
+    state = trainer.init_state()
+    shardings = trainer.batch_shardings
+    rng = np.random.default_rng(0)
+    # Pre-stage batches on device: the benchmark measures the training
+    # step (the thing the metric is defined over), not the synthetic-data
+    # host pipeline / tunnel transfer.
+    batches = [
+        jax.device_put(task.make_batch(rng, task.batch_size), shardings)
+        for _ in range(4)
+    ]
+
+    def step(state, i):
+        return trainer._step_fn(state, batches[i % len(batches)], jax.random.key(i))
+
+    for i in range(warmup):
+        state, metrics = step(state, i)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        state, metrics = step(state, i)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = task.batch_size * steps / dt
+    value = images_per_sec / n_chips
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            prior = json.load(open(baseline_path))
+            if prior.get("value"):
+                vs = value / float(prior["value"])
+        except (ValueError, KeyError):
+            pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

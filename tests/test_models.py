@@ -1,0 +1,204 @@
+"""Model-family tests (SURVEY.md §7 step 6): each model trains on its
+synthetic-but-learnable data (loss falls), and runs sharded over a
+multi-axis mesh on the 8-virtual-device CPU backend — the data-plane
+analogue of the reference's fake-clientset hermetic tests (SURVEY.md §4).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tfk8s_tpu.parallel.mesh import make_mesh
+from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+
+def _fit(task, mesh, steps=30, lr=1e-2):
+    cfg = TrainConfig(steps=steps, learning_rate=lr, log_every=max(steps // 3, 1))
+    trainer = Trainer(task, cfg, mesh)
+    _, history = trainer.fit()
+    return history
+
+
+class TestResNet:
+    def _task(self, **kw):
+        from tfk8s_tpu.models import resnet
+
+        kw.setdefault("depth", 18)
+        kw.setdefault("num_classes", 8)
+        kw.setdefault("image_size", 32)
+        kw.setdefault("batch_size", 16)
+        kw.setdefault("width", 8)
+        return resnet.make_task(**kw)
+
+    def test_loss_falls_data_parallel(self):
+        history = _fit(self._task(), make_mesh(data=8), steps=30, lr=3e-3)
+        assert history[-1]["loss"] < history[0]["loss"]
+
+    def test_fsdp_mesh_shards_conv_kernels(self):
+        from tfk8s_tpu.models import resnet
+        from tfk8s_tpu.parallel import sharding as shd
+
+        mesh = make_mesh(data=2, fsdp=4)
+        task = self._task(width=16)
+        cfg = TrainConfig(steps=2, learning_rate=1e-3)
+        trainer = Trainer(task, cfg, mesh)
+        state = trainer.init_state()
+        # a stage conv kernel must actually be sharded over fsdp on its
+        # output-channel dim
+        kern = state.params["stage1_block1"]["conv1"]["kernel"]
+        assert kern.sharding.spec == jax.sharding.PartitionSpec(None, None, None, "fsdp")
+        assert kern.addressable_shards[0].data.shape[-1] == kern.shape[-1] // 4
+        state, metrics = trainer._step_fn(
+            state,
+            jax.device_put(
+                task.make_batch(np.random.default_rng(0), task.batch_size),
+                trainer.batch_shardings,
+            ),
+            jax.random.key(0),
+        )
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_resnet50_shape(self):
+        # full-depth graph builds (tiny spatial size to keep CPU time low)
+        from tfk8s_tpu.models.resnet import ResNet
+
+        model = ResNet(depth=50, num_classes=10, width=8)
+        import jax.numpy as jnp
+
+        params = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))["params"]
+        out = model.apply({"params": params}, jnp.zeros((2, 64, 64, 3)))
+        assert out.shape == (2, 10)
+        assert sum(x.size for x in jax.tree_util.tree_leaves(params)) > 100_000
+
+
+class TestBert:
+    def _task(self, **kw):
+        from tfk8s_tpu.models import bert
+
+        cfg = bert.tiny_config(**kw.pop("cfg_overrides", {}))
+        kw.setdefault("seq_len", 32)
+        kw.setdefault("batch_size", 16)
+        return bert.make_task(cfg=cfg, **kw)
+
+    def test_mlm_loss_falls(self):
+        history = _fit(self._task(), make_mesh(data=8), steps=40, lr=3e-3)
+        assert history[-1]["loss"] < history[0]["loss"]
+        assert history[-1]["mlm_accuracy"] > history[0]["mlm_accuracy"]
+
+    def test_tensor_parallel_shards_heads(self):
+        mesh = make_mesh(data=2, tensor=4)
+        task = self._task()
+        trainer = Trainer(task, TrainConfig(steps=1), mesh)
+        state = trainer.init_state()
+        qkern = state.params["layer0"]["attn"]["q"]["kernel"]  # [embed, heads, kv]
+        # heads dim sharded over tensor=4
+        spec = qkern.sharding.spec
+        assert "tensor" in str(spec)
+        _, metrics = trainer._step_fn(
+            state,
+            jax.device_put(
+                task.make_batch(np.random.default_rng(0), task.batch_size),
+                trainer.batch_shardings,
+            ),
+            jax.random.key(0),
+        )
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_remat_matches_no_remat(self):
+        from tfk8s_tpu.models import bert
+        import jax.numpy as jnp
+
+        mesh = make_mesh(data=1)
+        t_plain = bert.make_task(cfg=bert.tiny_config(remat=False), seq_len=16, batch_size=4)
+        t_remat = bert.make_task(cfg=bert.tiny_config(remat=True), seq_len=16, batch_size=4)
+        batch = t_plain.make_batch(np.random.default_rng(0), 4)
+        p1 = t_plain.init(jax.random.key(0))
+        p2 = t_remat.init(jax.random.key(0))
+        from tfk8s_tpu.parallel.sharding import unbox
+
+        l1, _ = t_plain.loss_fn(unbox(p1), batch, jax.random.key(1))
+        l2, _ = t_remat.loss_fn(unbox(p2), batch, jax.random.key(1))
+        assert jnp.allclose(l1, l2, atol=1e-5)
+
+    def test_base_config_is_bert_base(self):
+        from tfk8s_tpu.models import bert
+
+        cfg = bert.base_config()
+        assert (cfg.num_layers, cfg.embed_dim, cfg.num_heads, cfg.mlp_dim) == (
+            12, 768, 12, 3072,
+        )
+
+
+class TestT5:
+    def _task(self, **kw):
+        from tfk8s_tpu.models import t5
+
+        cfg = t5.tiny_config(**kw.pop("cfg_overrides", {}))
+        kw.setdefault("seq_len", 16)
+        kw.setdefault("batch_size", 16)
+        return t5.make_task(cfg=cfg, **kw)
+
+    def test_seq2seq_loss_falls(self):
+        history = _fit(self._task(), make_mesh(data=8), steps=40, lr=3e-3)
+        assert history[-1]["loss"] < history[0]["loss"]
+
+    def test_spmd_tensor_sharding_runs(self):
+        mesh = make_mesh(data=2, tensor=4)
+        task = self._task()
+        trainer = Trainer(task, TrainConfig(steps=1), mesh)
+        state = trainer.init_state()
+        # decoder cross-attn q kernel [embed, heads, kv]: heads over tensor
+        q = state.params["dec0"]["cross_attn"]["q"]["kernel"]
+        assert "tensor" in str(q.sharding.spec)
+        _, metrics = trainer._step_fn(
+            state,
+            jax.device_put(
+                task.make_batch(np.random.default_rng(0), task.batch_size),
+                trainer.batch_shardings,
+            ),
+            jax.random.key(0),
+        )
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_base_config_is_t5_base(self):
+        from tfk8s_tpu.models import t5
+
+        cfg = t5.base_config()
+        assert (cfg.num_layers, cfg.embed_dim, cfg.num_heads, cfg.mlp_dim) == (
+            12, 768, 12, 3072,
+        )
+
+
+class TestDLRM:
+    def _task(self, **kw):
+        from tfk8s_tpu.models import dlrm
+
+        kw.setdefault("vocab_sizes", (64,) * 4)
+        kw.setdefault("embed_dim", 16)
+        kw.setdefault("dense_features", 8)
+        kw.setdefault("batch_size", 256)
+        return dlrm.make_task(**kw)
+
+    def test_ctr_loss_falls(self):
+        history = _fit(self._task(), make_mesh(data=8), steps=40, lr=1e-2)
+        assert history[-1]["loss"] < history[0]["loss"]
+
+    def test_embedding_tables_shard_over_tensor_axis(self):
+        # the TPUEmbedding analogue: vocab dim model-parallel over `tensor`,
+        # dense MLPs data-parallel — PS replacement per SURVEY.md §2
+        mesh = make_mesh(data=2, tensor=4)
+        task = self._task()
+        trainer = Trainer(task, TrainConfig(steps=1), mesh)
+        state = trainer.init_state()
+        table = state.params["table0"]["embedding"]
+        assert table.sharding.spec[0] == "tensor"
+        assert table.addressable_shards[0].data.shape[0] == table.shape[0] // 4
+        _, metrics = trainer._step_fn(
+            state,
+            jax.device_put(
+                task.make_batch(np.random.default_rng(0), task.batch_size),
+                trainer.batch_shardings,
+            ),
+            jax.random.key(0),
+        )
+        assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,93 @@
+"""Operator options/flags — SURVEY.md C2 (`tf_operator/app/options/
+options.go`; 'Add flag' / 'Init flag and initlog' in images/tf2.png at
+k8s-operator.md:57).
+
+The reference's sequence is Main → New Option → Add flag → init
+flag+log → Run server; this module is the Option half: a dataclass of
+every operator knob plus argparse registration and parsing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import socket
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class Options:
+    # controller
+    workers: int = 2
+    resync_period_s: float = 0.0
+    namespace: str = "default"
+    # client rate limits (C10: token-bucket on the REST client)
+    qps: float = 50.0
+    burst: int = 100
+    # leader election (C17)
+    leader_elect: bool = False
+    lease_name: str = "tfk8s-tpu-operator"
+    lease_duration_s: float = 15.0
+    identity: str = ""
+    # cluster inventory: accelerator type -> number of slices
+    capacity: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # run the in-process kubelet (hermetic/local backend)
+    local_kubelet: bool = True
+    # logging
+    log_level: str = "info"
+
+    def __post_init__(self):
+        if not self.identity:
+            self.identity = f"{socket.gethostname()}-{id(self) & 0xFFFF:x}"
+
+    @staticmethod
+    def add_flags(parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("operator")
+        g.add_argument("--workers", type=int, default=2,
+                       help="reconcile worker count (Controller.Run N)")
+        g.add_argument("--resync-period", type=float, default=0.0, dest="resync_period_s",
+                       help="informer resync period in seconds (0 = disabled)")
+        g.add_argument("--namespace", default="default")
+        g.add_argument("--qps", type=float, default=50.0,
+                       help="client token-bucket refill rate")
+        g.add_argument("--burst", type=int, default=100,
+                       help="client token-bucket burst size")
+        g.add_argument("--leader-elect", action="store_true", dest="leader_elect",
+                       help="gate reconciling behind a lease (HA)")
+        g.add_argument("--lease-name", default="tfk8s-tpu-operator")
+        g.add_argument("--lease-duration", type=float, default=15.0,
+                       dest="lease_duration_s")
+        g.add_argument("--identity", default="",
+                       help="leader-election identity (default: hostname-derived)")
+        g.add_argument("--capacity", default="{}",
+                       help='slice inventory as JSON, e.g. \'{"v5p-32": 4}\'')
+        g.add_argument("--no-local-kubelet", action="store_false",
+                       dest="local_kubelet",
+                       help="do not run the in-process pod executor")
+        g.add_argument("--log-level", default="info",
+                       choices=["debug", "info", "warning", "error"])
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "Options":
+        capacity = args.capacity
+        if isinstance(capacity, str):
+            capacity = json.loads(capacity or "{}")
+        return cls(
+            workers=args.workers,
+            resync_period_s=args.resync_period_s,
+            namespace=args.namespace,
+            qps=args.qps,
+            burst=args.burst,
+            leader_elect=args.leader_elect,
+            lease_name=args.lease_name,
+            lease_duration_s=args.lease_duration_s,
+            identity=args.identity,
+            capacity=capacity,
+            local_kubelet=args.local_kubelet,
+            log_level=args.log_level,
+        )
+
+    def log_level_int(self) -> int:
+        return getattr(logging, self.log_level.upper(), logging.INFO)

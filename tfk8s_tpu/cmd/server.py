@@ -1,0 +1,91 @@
+"""Operator server — SURVEY.md C3 (`tf_operator/app/server.go`, 'Run
+server' in images/tf2.png): wires clients → informers → controller,
+gates reconciling behind leader election when asked (k8s-operator.md:59),
+and runs until stopped.
+
+The store backend is the in-process ClusterStore (client/store.py) — the
+same List/Watch surface a real apiserver would present; swapping in a
+networked backend changes only Clientset construction here (SURVEY.md §7
+step 2).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tfk8s_tpu.client.clientset import Clientset, RESTConfig
+from tfk8s_tpu.client.store import ClusterStore
+from tfk8s_tpu.controller.leaderelection import LeaderElector
+from tfk8s_tpu.cmd.options import Options
+from tfk8s_tpu.runtime.kubelet import LocalKubelet
+from tfk8s_tpu.trainer.gang import SliceAllocator
+from tfk8s_tpu.trainer.tpujob_controller import TPUJobController
+from tfk8s_tpu.utils.logging import EventRecorder, Metrics, get_logger, init_logging
+
+log = get_logger("server")
+
+
+class Server:
+    """Owns every long-lived component of one operator process."""
+
+    def __init__(self, opts: Options, store: Optional[ClusterStore] = None):
+        self.opts = opts
+        self.store = store if store is not None else ClusterStore()
+        self.clientset = Clientset.new_for_config(
+            self.store, RESTConfig(qps=opts.qps, burst=opts.burst)
+        )
+        self.allocator = SliceAllocator(opts.capacity or None)
+        self.recorder = EventRecorder()
+        self.metrics = Metrics()
+        self.controller = TPUJobController(
+            self.clientset,
+            allocator=self.allocator,
+            recorder=self.recorder,
+            metrics=self.metrics,
+            resync_period=opts.resync_period_s,
+        )
+        self.kubelet = LocalKubelet(self.clientset) if opts.local_kubelet else None
+        self._threads: list = []
+
+    def run(self, stop: threading.Event, block: bool = True) -> None:
+        """Start kubelet + controller (possibly behind the leader gate).
+        With ``block=False`` returns once everything is started."""
+        init_logging(self.opts.log_level_int())
+        if self.kubelet:
+            self.kubelet.run(stop)  # informer-driven; returns immediately
+
+        if not self.opts.leader_elect:
+            log.info("starting controller with %d workers", self.opts.workers)
+            self.controller.run(self.opts.workers, stop, block=block)
+            if block:
+                stop.wait()
+            return
+
+        elector = LeaderElector(
+            self.clientset.generic("Lease", self.opts.namespace),
+            identity=self.opts.identity,
+            lease_name=self.opts.lease_name,
+            namespace=self.opts.namespace,
+            lease_duration_s=self.opts.lease_duration_s,
+        )
+
+        def lead(child_stop: threading.Event) -> None:
+            log.info(
+                "acquired lease %s as %s; starting controller",
+                self.opts.lease_name, self.opts.identity,
+            )
+            self.controller.run(self.opts.workers, child_stop, block=False)
+
+        def run_elector():
+            elector.run(lead, stop, on_stopped_leading=self.shutdown)
+
+        t = threading.Thread(target=run_elector, daemon=True, name="leader-elector")
+        t.start()
+        self._threads.append(t)
+        self.elector = elector
+        if block:
+            stop.wait()
+
+    def shutdown(self) -> None:
+        self.controller.controller.shutdown()

@@ -1,0 +1,180 @@
+"""BERT-base MLM pretraining — BASELINE.json configs[2]: 'BERT-base MLM
+pretraining (XLA all-reduce over ICI)'. Headline metric: step-time on a
+v5p-32-shaped mesh (BASELINE.json "metric"); the reference publishes
+nothing (SURVEY.md §6).
+
+The model is the shared encoder stack (models/transformer.py) with a tied
+output head; gradients all-reduce over the ``data`` mesh axis as XLA
+collectives — the exact north-star replacement for
+MultiWorkerMirroredStrategy+NCCL (BASELINE.json north_star).
+
+Hermetic data: sequences follow a fixed affine chain
+``t[i+1] = (a*t[i] + b) mod V`` with random restarts, so a masked token is
+predictable from either neighbor — MLM loss falls fast and convergence is
+testable without a corpus.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from tfk8s_tpu.models.transformer import (
+    Embedder,
+    EncoderLayer,
+    TransformerConfig,
+    _ln,
+    maybe_remat,
+)
+from tfk8s_tpu.runtime.train import TrainTask, run_task
+
+MASK_ID = 0  # reserved mask token; chain tokens live in [1, vocab)
+_CHAIN_A, _CHAIN_B = 31, 17
+_RESTART_P = 0.05
+MASK_RATE = 0.15
+
+
+class BertWithHead(nn.Module):
+    """Encoder + tied-embedding MLM head, exposed as one module so the
+    embedding table is shared naturally. ``attn_fn`` swaps the inner
+    attention computation (ring attention on sequence-sharded meshes)."""
+
+    cfg: TransformerConfig
+    attn_fn: Optional[Any] = None
+
+    def setup(self):
+        self.embed = Embedder(self.cfg, name="embed")
+        layer = maybe_remat(EncoderLayer, self.cfg)
+        self.layers = [
+            layer(self.cfg, attn_fn=self.attn_fn, name=f"layer{i}")
+            for i in range(self.cfg.num_layers)
+        ]
+        self.ln_final = _ln("ln_final")
+
+    def __call__(self, ids: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        x = self.embed(ids)
+        for layer in self.layers:
+            x = layer(x, mask)
+        x = self.ln_final(x).astype(self.cfg.dtype)
+        return self.embed.logits(x)  # [b, l, vocab], fp32
+
+
+def base_config(**overrides) -> TransformerConfig:
+    """BERT-base: 12 layers / 768 hidden / 12 heads / 3072 mlp."""
+    kw = dict(
+        vocab_size=30522, embed_dim=768, num_heads=12, head_dim=64,
+        mlp_dim=3072, num_layers=12, max_len=512,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def make_batch_fn(vocab: int, seq_len: int):
+    def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
+        toks = np.empty((batch_size, seq_len), np.int64)
+        toks[:, 0] = rng.integers(1, vocab, size=batch_size)
+        restarts = rng.random((batch_size, seq_len)) < _RESTART_P
+        fresh = rng.integers(1, vocab, size=(batch_size, seq_len))
+        for i in range(1, seq_len):
+            nxt = (_CHAIN_A * toks[:, i - 1] + _CHAIN_B) % (vocab - 1) + 1
+            toks[:, i] = np.where(restarts[:, i], fresh[:, i], nxt)
+        mlm_mask = rng.random((batch_size, seq_len)) < MASK_RATE
+        inputs = np.where(mlm_mask, MASK_ID, toks)
+        return {
+            "input": inputs.astype(np.int32),
+            "target": toks.astype(np.int32),
+            "mlm_mask": mlm_mask,
+        }
+
+    return make_batch
+
+
+def make_task(
+    cfg: Optional[TransformerConfig] = None,
+    seq_len: int = 128,
+    batch_size: int = 64,
+    targets: Optional[Dict[str, float]] = None,
+    attn_fn: Optional[Any] = None,
+) -> TrainTask:
+    cfg = cfg or base_config()
+    seq_len = min(seq_len, cfg.max_len)
+    model = BertWithHead(cfg, attn_fn=attn_fn)
+
+    def init(rng):
+        # full batch shape: ring attention's shard_map needs the batch dim
+        # divisible by the data axis even at trace time
+        return model.init(rng, jnp.zeros((batch_size, seq_len), jnp.int32))["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = model.apply({"params": params}, batch["input"])
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["target"]
+        )
+        w = batch["mlm_mask"].astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        loss = jnp.sum(per_tok * w) / denom
+        acc = jnp.sum(
+            (jnp.argmax(logits, -1) == batch["target"]).astype(jnp.float32) * w
+        ) / denom
+        return loss, {"mlm_accuracy": acc}
+
+    return TrainTask(
+        name="bert-mlm",
+        init=init,
+        loss_fn=loss_fn,
+        make_batch=make_batch_fn(cfg.vocab_size, seq_len),
+        batch_size=batch_size,
+        targets=targets or {},
+    )
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """Test-scale config (runs in seconds on the CPU backend)."""
+    kw = dict(
+        vocab_size=64, embed_dim=32, num_heads=4, head_dim=8,
+        mlp_dim=64, num_layers=2, max_len=64,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def task_for_mesh(
+    mesh,
+    cfg: Optional[TransformerConfig] = None,
+    **task_kw,
+) -> TrainTask:
+    """Build the task with the attention impl the mesh calls for: ring
+    attention whenever the mesh has a nontrivial ``sequence`` axis or the
+    config asks for it explicitly (cfg.attention_impl == 'ring')."""
+    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
+    from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
+
+    cfg = cfg or base_config()
+    seq_sharded = (
+        AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
+    )
+    attn_fn = None
+    if cfg.attention_impl == "ring" or seq_sharded:
+        attn_fn = make_ring_attn_fn(mesh)
+    return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
+
+
+def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint: ``tfk8s_tpu.models.bert:train``."""
+    from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
+
+    env = dict(env)
+    env.setdefault("TFK8S_TRAIN_STEPS", "100")
+    env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
+    seq = int(env.get("TFK8S_SEQ_LEN", "128"))
+    batch = int(env.get("TFK8S_BATCH_SIZE", "64"))
+    ctx = ProcessContext.from_env(env)
+    initialize_distributed(ctx, env)
+    mesh = build_mesh(ctx)
+    task = task_for_mesh(mesh, seq_len=seq, batch_size=batch)
+    run_task(task, env, stop, mesh=mesh)

@@ -1,0 +1,191 @@
+"""DLRM / Wide&Deep CTR model — BASELINE.json configs[4]:
+'Wide&Deep / DLRM (ParameterServerStrategy → TPUEmbedding)'.
+
+This is the honest TPU translation of the reference's parameter-server
+half (k8s-operator.md:6; SURVEY.md §2 'PS-semantics mapping', §7 hard
+part 3): instead of PS processes hosting big embedding tables behind
+gRPC, the tables are *sharded by annotation* over the mesh — each
+categorical feature's table carries logical axes ``("vocab", "embed")``,
+so the vocab dim splits over the ``tensor`` axis (TPUEmbedding-style
+model parallelism) while the dense MLPs run data-parallel. GSPMD emits
+the gather + all-to-all; no parameter server exists.
+
+Architecture (standard DLRM):
+  bottom MLP(dense features) ┐
+                             ├─ pairwise dot interaction ─ top MLP ─ CTR logit
+  embedding lookups (sparse) ┘
+
+Hermetic data: clicks are generated from a ground-truth low-rank
+feature-affinity model, so log-loss falls measurably.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from tfk8s_tpu.runtime.train import TrainTask, run_task
+
+
+def _mlp_dense(features: int, name: str):
+    # dense MLPs run data-parallel: input dim replicated (odd widths like
+    # dense_features=13 must not shard), hidden widths split via "mlp",
+    # the scalar logit layer fully replicated
+    names = (None, "mlp") if features > 1 else (None, None)
+    return nn.Dense(
+        features,
+        dtype=jnp.bfloat16,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.lecun_normal(), names
+        ),
+        name=name,
+    )
+
+
+class Mlp(nn.Module):
+    layers: Sequence[int]
+    name_prefix: str = "fc"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, width in enumerate(self.layers):
+            x = _mlp_dense(width, f"{self.name_prefix}{i}")(x)
+            if i < len(self.layers) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class DLRM(nn.Module):
+    """num_tables categorical features, one sharded table each."""
+
+    vocab_sizes: Sequence[int]
+    embed_dim: int = 64
+    dense_features: int = 13
+    # bottom MLP must end at embed_dim so its output stacks with the
+    # embeddings for the dot interaction
+    bottom_layers: Optional[Sequence[int]] = None
+    top_layers: Sequence[int] = (512, 256, 1)
+
+    def _bottom(self) -> Sequence[int]:
+        if self.bottom_layers is not None:
+            return self.bottom_layers
+        return (512, 256, self.embed_dim)
+
+    @nn.compact
+    def __call__(self, dense: jax.Array, sparse: jax.Array) -> jax.Array:
+        # sparse: [batch, num_tables] int ids
+        embs = []
+        for t, vocab in enumerate(self.vocab_sizes):
+            table = nn.Embed(
+                vocab,
+                self.embed_dim,
+                param_dtype=jnp.float32,
+                embedding_init=nn.with_partitioning(
+                    nn.initializers.normal(0.01), ("vocab", "embed")
+                ),
+                name=f"table{t}",
+            )
+            embs.append(table(sparse[:, t]).astype(jnp.bfloat16))
+
+        bottom = Mlp(self._bottom(), name="bottom")(dense.astype(jnp.bfloat16))
+        feats = jnp.stack([bottom] + embs, axis=1)  # [b, 1+T, embed_dim]
+
+        # pairwise dot interaction, upper triangle (DLRM-style)
+        inter = jnp.einsum("bne,bme->bnm", feats, feats)
+        n = feats.shape[1]
+        iu, ju = jnp.triu_indices(n, k=1)
+        inter_flat = inter[:, iu, ju]
+
+        top_in = jnp.concatenate([bottom, inter_flat.astype(jnp.bfloat16)], axis=-1)
+        logit = Mlp(self.top_layers, name="top")(top_in)
+        return logit[:, 0].astype(jnp.float32)
+
+
+# -- synthetic learnable CTR data --------------------------------------------
+
+_GT_SEED = 777
+
+
+@functools.lru_cache(maxsize=None)
+def _ground_truth(vocab_sizes: Tuple[int, ...], dense_features: int, rank: int = 4):
+    rng = np.random.default_rng(_GT_SEED)
+    table_vecs = [
+        rng.standard_normal((v, rank)).astype(np.float32) for v in vocab_sizes
+    ]
+    dense_w = rng.standard_normal((dense_features, rank)).astype(np.float32)
+    return table_vecs, dense_w
+
+
+def make_batch_fn(vocab_sizes: Tuple[int, ...], dense_features: int):
+    table_vecs, dense_w = _ground_truth(vocab_sizes, dense_features)
+
+    def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
+        dense = rng.standard_normal((batch_size, dense_features)).astype(np.float32)
+        sparse = np.stack(
+            [rng.integers(0, v, size=batch_size) for v in vocab_sizes], axis=1
+        )
+        # click probability from latent-factor affinities
+        latent = dense @ dense_w
+        for t, vecs in enumerate(table_vecs):
+            latent = latent + vecs[sparse[:, t]]
+        score = np.sum(latent, axis=-1) / np.sqrt(latent.shape[-1])
+        p = 1.0 / (1.0 + np.exp(-1.5 * score))
+        click = (rng.random(batch_size) < p).astype(np.float32)
+        return {
+            "dense": dense,
+            "sparse": sparse.astype(np.int32),
+            "click": click,
+        }
+
+    return make_batch
+
+
+def make_task(
+    vocab_sizes: Sequence[int] = (100_000,) * 8,
+    embed_dim: int = 64,
+    dense_features: int = 13,
+    batch_size: int = 4096,
+    targets: Optional[Dict[str, float]] = None,
+) -> TrainTask:
+    vocab_sizes = tuple(vocab_sizes)
+    model = DLRM(
+        vocab_sizes=vocab_sizes, embed_dim=embed_dim, dense_features=dense_features
+    )
+
+    def init(rng):
+        return model.init(
+            rng,
+            jnp.zeros((1, dense_features), jnp.float32),
+            jnp.zeros((1, len(vocab_sizes)), jnp.int32),
+        )["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logit = model.apply({"params": params}, batch["dense"], batch["sparse"])
+        loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logit, batch["click"]))
+        acc = jnp.mean(((logit > 0) == (batch["click"] > 0.5)).astype(jnp.float32))
+        return loss, {"click_accuracy": acc}
+
+    return TrainTask(
+        name="dlrm",
+        init=init,
+        loss_fn=loss_fn,
+        make_batch=make_batch_fn(vocab_sizes, dense_features),
+        batch_size=batch_size,
+        targets=targets or {},
+    )
+
+
+def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint: ``tfk8s_tpu.models.dlrm:train``."""
+    env = dict(env)
+    env.setdefault("TFK8S_TRAIN_STEPS", "100")
+    env.setdefault("TFK8S_LEARNING_RATE", "1e-3")
+    batch = int(env.get("TFK8S_BATCH_SIZE", "4096"))
+    run_task(make_task(batch_size=batch), env, stop)

@@ -1,0 +1,224 @@
+"""ResNet-50 — BASELINE.json configs[1]: 'ResNet-50 ImageNet (TPUStrategy
+data-parallel on v5p mesh)'. The headline metric is images/sec/chip
+(BASELINE.json "metric"); the reference itself publishes no numbers
+(SURVEY.md §6), so this model establishes the baseline.
+
+TPU-first design choices (vs a torch/GPU translation):
+
+- **GroupNorm, not BatchNorm.** BatchNorm needs a cross-replica moment
+  all-reduce every layer (or per-replica stats that drift) plus mutable
+  running-stat state. GroupNorm is stateless, batch-independent, fuses
+  into the surrounding convs under XLA, and keeps the train step a pure
+  function — the whole model stays one jittable pure fn.
+- **bfloat16 compute, float32 params.** Convs/matmuls run on the MXU in
+  bf16; the optimizer update and norms stay fp32.
+- **NHWC layout** — XLA:TPU's native conv layout.
+- Kernels carry logical axes (``conv_out`` → fsdp; final dense
+  ``embed``/``vocab``) so the same model runs data-parallel or FSDP
+  without edits (parallel/sharding.py rules).
+
+Data is hermetic/synthetic: class-conditional templates + noise, so the
+loss measurably falls (a learnable task) with zero dataset I/O — same
+philosophy as models/mlp.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from tfk8s_tpu.runtime.train import TrainTask, run_task
+
+# stage depths for the standard variants
+DEPTHS = {
+    18: (2, 2, 2, 2),
+    34: (3, 4, 6, 3),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+BOTTLENECK = {50, 101, 152}
+
+_conv_part = functools.partial(
+    nn.with_partitioning,
+    names=("conv_k", "conv_k", "conv_in", "conv_out"),
+)
+
+
+def _conv(features: int, kernel: int, strides: int = 1, name: Optional[str] = None):
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(strides, strides),
+        padding="SAME",
+        use_bias=False,
+        dtype=jnp.bfloat16,
+        param_dtype=jnp.float32,
+        kernel_init=_conv_part(nn.initializers.variance_scaling(2.0, "fan_out", "normal")),
+        name=name,
+    )
+
+
+def _groups(channels: int) -> int:
+    # 32 groups is the GN paper default; shrink until it divides (small
+    # test widths).
+    g = min(32, channels)
+    while channels % g:
+        g //= 2
+    return max(g, 1)
+
+
+def _norm(channels: int, name: Optional[str] = None, scale_init=nn.initializers.ones):
+    return nn.GroupNorm(
+        num_groups=_groups(channels), dtype=jnp.float32, param_dtype=jnp.float32,
+        scale_init=scale_init, name=name,
+    )
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 with 4x expansion; zero-init final norm scale so
+    each residual branch starts as identity (standard trick, helps large
+    batch — and costs nothing under XLA)."""
+
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = _conv(self.features, 1, name="conv1")(x)
+        y = nn.relu(_norm(self.features, name="norm1")(y))
+        y = _conv(self.features, 3, self.strides, name="conv2")(y)
+        y = nn.relu(_norm(self.features, name="norm2")(y))
+        y = _conv(self.features * 4, 1, name="conv3")(y)
+        y = _norm(self.features * 4, name="norm3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.features * 4, 1, self.strides, name="proj")(x)
+            residual = _norm(self.features * 4, name="proj_norm")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3, for ResNet-18/34 (small/test variants)."""
+
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = _conv(self.features, 3, self.strides, name="conv1")(x)
+        y = nn.relu(_norm(self.features, name="norm1")(y))
+        y = _conv(self.features, 3, name="conv2")(y)
+        y = _norm(self.features, name="norm2", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(self.features, 1, self.strides, name="proj")(x)
+            residual = _norm(self.features, name="proj_norm")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64  # stem width; stages are width * (1,2,4,8)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.bfloat16)
+        x = _conv(self.width, 7, 2, name="stem")(x)
+        x = nn.relu(_norm(self.width, name="stem_norm")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block = BottleneckBlock if self.depth in BOTTLENECK else BasicBlock
+        for stage, depth in enumerate(DEPTHS[self.depth]):
+            for i in range(depth):
+                x = block(
+                    self.width * (2 ** stage),
+                    strides=2 if stage > 0 and i == 0 else 1,
+                    name=f"stage{stage + 1}_block{i + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # global average pool
+        return nn.Dense(
+            self.num_classes,
+            dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")
+            ),
+            name="classifier",
+        )(x)
+
+
+# -- synthetic learnable data -------------------------------------------------
+
+_TEMPLATE_SEED = 4321
+
+
+@functools.lru_cache(maxsize=None)
+def _templates(num_classes: int, image_size: int) -> np.ndarray:
+    rng = np.random.default_rng(_TEMPLATE_SEED)
+    return rng.standard_normal((num_classes, image_size, image_size, 3)).astype(
+        np.float32
+    )
+
+
+def make_batch_fn(num_classes: int, image_size: int):
+    temps = _templates(num_classes, image_size)
+
+    def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
+        y = rng.integers(0, num_classes, size=(batch_size,), dtype=np.int64)
+        noise = rng.standard_normal((batch_size, image_size, image_size, 3))
+        x = (0.6 * temps[y] + noise).astype(np.float32)
+        return {"image": x, "label": y.astype(np.int32)}
+
+    return make_batch
+
+
+def make_task(
+    depth: int = 50,
+    num_classes: int = 1000,
+    image_size: int = 224,
+    batch_size: int = 256,
+    width: int = 64,
+    targets: Optional[Dict[str, float]] = None,
+) -> TrainTask:
+    model = ResNet(depth=depth, num_classes=num_classes, width=width)
+
+    def init(rng):
+        return model.init(
+            rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+        )["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = model.apply({"params": params}, batch["image"])
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
+        )
+        acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    return TrainTask(
+        name=f"resnet{depth}",
+        init=init,
+        loss_fn=loss_fn,
+        make_batch=make_batch_fn(num_classes, image_size),
+        batch_size=batch_size,
+        targets=targets or {},
+    )
+
+
+def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint: ``tfk8s_tpu.models.resnet:train``."""
+    env = dict(env)
+    env.setdefault("TFK8S_TRAIN_STEPS", "100")
+    env.setdefault("TFK8S_LEARNING_RATE", "1e-3")
+    depth = int(env.get("TFK8S_RESNET_DEPTH", "50"))
+    batch = int(env.get("TFK8S_BATCH_SIZE", "256"))
+    image = int(env.get("TFK8S_IMAGE_SIZE", "224"))
+    run_task(
+        make_task(depth=depth, batch_size=batch, image_size=image), env, stop
+    )

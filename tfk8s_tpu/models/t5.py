@@ -1,0 +1,160 @@
+"""T5-base encoder-decoder — BASELINE.json configs[3]: 'T5-base seq2seq
+(XLA SPMD model-parallel sharding)'. The point of this config is the
+GSPMD tensor-parallel path: every projection carries logical axes
+(models/transformer.py), so on a mesh with a ``tensor`` axis the weights
+shard Megatron-style over ICI — the TP row of SURVEY.md §2's parallelism
+table, which the reference lacks entirely.
+
+Architecture notes (kept deliberately close to the shared blocks rather
+than a faithful T5 reimplementation — the framework's job is the sharded
+execution, not checkpoint compatibility):
+
+- pre-LN blocks, learned positions, tied softmax (models/transformer.py)
+  instead of T5's relative-position biases and RMSNorm;
+- teacher-forced decoding; loss is cross-entropy over the target
+  sequence with padding masked out.
+
+Hermetic data: sequence reversal — target = reversed(source). The
+decoder must actually use cross-attention to solve it (a copy-through
+would fail), so convergence demonstrates the full enc-dec path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from tfk8s_tpu.models.transformer import (
+    DecoderLayer,
+    Embedder,
+    EncoderLayer,
+    TransformerConfig,
+    _ln,
+    maybe_remat,
+)
+from tfk8s_tpu.runtime.train import TrainTask, run_task
+
+PAD_ID = 0
+BOS_ID = 1
+# real tokens live in [2, vocab)
+
+
+class T5(nn.Module):
+    """Encoder-decoder with a shared embedding table and tied head."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed = Embedder(cfg, name="embed")
+        enc_layer = maybe_remat(EncoderLayer, cfg)
+        dec_layer = maybe_remat(DecoderLayer, cfg)
+        self.enc_layers = [enc_layer(cfg, name=f"enc{i}") for i in range(cfg.num_layers)]
+        self.dec_layers = [dec_layer(cfg, name=f"dec{i}") for i in range(cfg.num_layers)]
+        self.enc_ln = _ln("enc_ln")
+        self.dec_ln = _ln("dec_ln")
+
+    def encode(self, src: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        mask = src != PAD_ID
+        x = self.embed(src)
+        for layer in self.enc_layers:
+            x = layer(x, mask)
+        return self.enc_ln(x).astype(self.cfg.dtype), mask
+
+    def decode(self, tgt_in: jax.Array, enc: jax.Array, enc_mask: jax.Array) -> jax.Array:
+        x = self.embed(tgt_in)
+        for layer in self.dec_layers:
+            x = layer(x, enc, enc_mask)
+        x = self.dec_ln(x).astype(self.cfg.dtype)
+        return self.embed.logits(x)
+
+    def __call__(self, src: jax.Array, tgt_in: jax.Array) -> jax.Array:
+        enc, mask = self.encode(src)
+        return self.decode(tgt_in, enc, mask)
+
+
+def base_config(**overrides) -> TransformerConfig:
+    """T5-base-scale: 12+12 layers / 768 / 12 heads / 3072."""
+    kw = dict(
+        vocab_size=32128, embed_dim=768, num_heads=12, head_dim=64,
+        mlp_dim=3072, num_layers=12, max_len=512,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    kw = dict(
+        vocab_size=64, embed_dim=32, num_heads=4, head_dim=8,
+        mlp_dim=64, num_layers=2, max_len=64,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def make_batch_fn(vocab: int, seq_len: int):
+    def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
+        src = rng.integers(2, vocab, size=(batch_size, seq_len))
+        tgt = src[:, ::-1]  # reversal task
+        tgt_in = np.concatenate(
+            [np.full((batch_size, 1), BOS_ID, np.int64), tgt[:, :-1]], axis=1
+        )
+        return {
+            "src": src.astype(np.int32),
+            "tgt_in": tgt_in.astype(np.int32),
+            "tgt_out": tgt.astype(np.int32),
+        }
+
+    return make_batch
+
+
+def make_task(
+    cfg: Optional[TransformerConfig] = None,
+    seq_len: int = 128,
+    batch_size: int = 32,
+    targets: Optional[Dict[str, float]] = None,
+) -> TrainTask:
+    cfg = cfg or base_config()
+    seq_len = min(seq_len, cfg.max_len)
+    model = T5(cfg)
+
+    def init(rng):
+        z = jnp.zeros((1, seq_len), jnp.int32)
+        return model.init(rng, z, z)["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = model.apply({"params": params}, batch["src"], batch["tgt_in"])
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["tgt_out"]
+        )
+        w = (batch["tgt_out"] != PAD_ID).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        loss = jnp.sum(per_tok * w) / denom
+        acc = jnp.sum(
+            (jnp.argmax(logits, -1) == batch["tgt_out"]).astype(jnp.float32) * w
+        ) / denom
+        return loss, {"token_accuracy": acc}
+
+    return TrainTask(
+        name="t5-seq2seq",
+        init=init,
+        loss_fn=loss_fn,
+        make_batch=make_batch_fn(cfg.vocab_size, seq_len),
+        batch_size=batch_size,
+        targets=targets or {},
+    )
+
+
+def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint: ``tfk8s_tpu.models.t5:train``."""
+    env = dict(env)
+    env.setdefault("TFK8S_TRAIN_STEPS", "100")
+    env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
+    seq = int(env.get("TFK8S_SEQ_LEN", "128"))
+    batch = int(env.get("TFK8S_BATCH_SIZE", "32"))
+    run_task(make_task(seq_len=seq, batch_size=batch), env, stop)

@@ -1,0 +1,225 @@
+"""Shared transformer building blocks for the BERT / T5 model families
+(BASELINE.json configs[2], configs[3]).
+
+TPU-first conventions, applied uniformly:
+
+- All projections are ``nn.DenseGeneral`` with logical-axis partitioning
+  (``embed``/``heads``/``kv``/``mlp`` — parallel/sharding.py rules), so
+  the Megatron-style tensor split (qkv+mlp-in column-wise, out+mlp-out
+  row-wise) falls out of the annotations; GSPMD inserts exactly the two
+  all-reduces per block over the ``tensor`` ICI axis.
+- bfloat16 activations, float32 params and layer norms.
+- No data-dependent Python control flow; masks are computed with lax ops
+  so one trace serves every batch.
+- ``remat`` flag wraps each layer in ``jax.checkpoint`` — the standard
+  HBM-for-FLOPs trade on TPU (SURVEY.md 'HBM bandwidth').
+- Attention optionally routes through the ring-attention kernel
+  (parallel/ring_attention.py) when the mesh has a nontrivial
+  ``sequence`` axis — the long-context path (SURVEY.md §5).
+
+The reference has no model code at all (its operator treats training as a
+black box, k8s-operator.md:6); these blocks are the data plane the north
+star prescribes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    embed_dim: int = 768
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    num_layers: int = 12
+    max_len: int = 512
+    dropout: float = 0.0  # keep 0 for determinism; hook exists
+    dtype: Dtype = jnp.bfloat16
+    remat: bool = False
+    # 'full' | 'ring' — ring requires a mesh with a sequence axis and is
+    # injected by the task wrapper (models/bert.py etc.)
+    attention_impl: str = "full"
+
+
+def _dense(features, names, name, dtype, axis=-1):
+    return nn.DenseGeneral(
+        features=features,
+        axis=axis,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_partitioning(nn.initializers.xavier_uniform(), names),
+        bias_init=nn.initializers.zeros,
+        name=name,
+    )
+
+
+class MultiHeadAttention(nn.Module):
+    """Self- or cross-attention. ``attn_fn`` lets the caller swap the
+    inner softmax(QK^T)V computation (e.g. for ring attention)."""
+
+    cfg: TransformerConfig
+    causal: bool = False
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        kv: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        cfg = self.cfg
+        kv = x if kv is None else kv
+        q = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q", cfg.dtype)(x)
+        k = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "k", cfg.dtype)(kv)
+        v = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "v", cfg.dtype)(kv)
+        q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
+
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v, mask=mask, causal=self.causal)
+        else:
+            out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
+
+        return _dense(
+            cfg.embed_dim, ("heads", "kv", "embed"), "out", cfg.dtype, axis=(-2, -1)
+        )(out)
+
+
+def dot_product_attention(
+    q: jax.Array,  # [b, lq, h, d] (pre-scaled)
+    k: jax.Array,  # [b, lk, h, d]
+    v: jax.Array,  # [b, lk, h, d]
+    mask: Optional[jax.Array] = None,  # [b, lk] key validity or [b, lq, lk]
+    causal: bool = False,
+) -> jax.Array:
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    neg = jnp.asarray(-1e9, scores.dtype)
+    if mask is not None:
+        m = mask[:, None, None, :] if mask.ndim == 2 else mask[:, None, :, :]
+        scores = jnp.where(m, scores, neg)
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        scores = jnp.where(cm[None, None], scores, neg)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h = _dense(cfg.mlp_dim, ("embed", "mlp"), "wi", cfg.dtype)(x)
+        h = nn.gelu(h)
+        return _dense(cfg.embed_dim, ("mlp", "embed"), "wo", cfg.dtype)(h)
+
+
+def _ln(name: str) -> nn.LayerNorm:
+    return nn.LayerNorm(
+        dtype=jnp.float32, param_dtype=jnp.float32, use_bias=True, name=name
+    )
+
+
+class EncoderLayer(nn.Module):
+    """Pre-LN residual block (more stable than post-LN, standard on TPU)."""
+
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.cfg
+        h = _ln("ln_attn")(x).astype(cfg.dtype)
+        x = x + MultiHeadAttention(cfg, attn_fn=self.attn_fn, name="attn")(h, mask=mask)
+        h = _ln("ln_mlp")(x).astype(cfg.dtype)
+        return x + MlpBlock(cfg, name="mlp")(h)
+
+
+class DecoderLayer(nn.Module):
+    """Causal self-attention + cross-attention + MLP (T5-style decoder)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        enc: jax.Array,
+        enc_mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        cfg = self.cfg
+        h = _ln("ln_self")(x).astype(cfg.dtype)
+        x = x + MultiHeadAttention(cfg, causal=True, name="self_attn")(h)
+        h = _ln("ln_cross")(x).astype(cfg.dtype)
+        x = x + MultiHeadAttention(cfg, name="cross_attn")(h, kv=enc, mask=enc_mask)
+        h = _ln("ln_mlp")(x).astype(cfg.dtype)
+        return x + MlpBlock(cfg, name="mlp")(h)
+
+
+class Embedder(nn.Module):
+    """Token + learned positional embeddings; the token table is reused
+    transposed as the output head (weight tying)."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.tok = nn.Embed(
+            cfg.vocab_size,
+            cfg.embed_dim,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="tok",
+        )
+        self.pos = self.param(
+            "pos",
+            nn.with_partitioning(nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_len, cfg.embed_dim),
+            jnp.float32,
+        )
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        x = self.tok(ids) + self.pos[: ids.shape[-1]]
+        return x.astype(self.cfg.dtype)
+
+    def logits(self, x: jax.Array) -> jax.Array:
+        # tied output head; fp32 logits for a stable softmax
+        return jnp.einsum(
+            "...d,vd->...v", x.astype(jnp.float32), self.tok.embedding
+        )
+
+
+def maybe_remat(layer_cls, cfg: TransformerConfig):
+    """jax.checkpoint each layer when cfg.remat — recompute activations in
+    the backward pass instead of holding them in HBM."""
+    if cfg.remat:
+        return nn.remat(layer_cls, prevent_cse=False)
+    return layer_cls
+
+
+class Encoder(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, ids: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.cfg
+        x = Embedder(cfg, name="embed")(ids)
+        layer = maybe_remat(EncoderLayer, cfg)
+        for i in range(cfg.num_layers):
+            x = layer(cfg, attn_fn=self.attn_fn, name=f"layer{i}")(x, mask)
+        return _ln("ln_final")(x).astype(cfg.dtype)

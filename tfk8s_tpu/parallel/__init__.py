@@ -20,3 +20,10 @@ from tfk8s_tpu.parallel.sharding import (  # noqa: F401
     shard_constraint,
     unbox,
 )
+from tfk8s_tpu.parallel.moe import SwitchMoeBlock  # noqa: F401
+from tfk8s_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+)
+from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn  # noqa: F401

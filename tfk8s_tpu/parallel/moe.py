@@ -1,0 +1,100 @@
+"""Expert parallelism: switch-style Mixture-of-Experts over the
+``expert`` mesh axis (the EP row of SURVEY.md §2's parallelism table —
+ABSENT in the reference, reserved by the mesh design).
+
+GShard/Switch formulation, deliberately einsum-only: dispatch and
+combine are dense einsums against a capacity-bucketed one-hot mask, the
+expert dim of every tensor carries the ``expert`` logical axis, and
+GSPMD lowers the dispatch/combine contractions to ``all_to_all`` over
+the expert ICI axis — no hand-written collectives (SURVEY.md §2
+'Distributed communication backend').
+
+Top-1 (switch) routing with a capacity factor; overflowing tokens fall
+through the residual connection (standard dropless-approximation
+behavior). The load-balancing auxiliary loss is the Switch Transformer
+one: E * sum_e(importance_e * load_e).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tfk8s_tpu.models.transformer import TransformerConfig
+
+
+class SwitchMoeBlock(nn.Module):
+    """Drop-in for models.transformer.MlpBlock with num_experts experts.
+
+    Returns (output, aux_loss); callers add ``aux_weight * aux_loss`` to
+    the objective.
+    """
+
+    cfg: TransformerConfig
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        g, s, m = x.shape  # [batch, seq, embed]
+        e = self.num_experts
+        h = cfg.mlp_dim
+        c = max(int(self.capacity_factor * s / e), 1)  # per-expert per-batch slots
+
+        router = self.param(
+            "router",
+            nn.with_partitioning(nn.initializers.normal(0.02), ("embed", "expert")),
+            (m, e),
+            jnp.float32,
+        )
+        wi = self.param(
+            "wi",
+            nn.with_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+                ("expert", "embed", "expert_mlp"),
+            ),
+            (e, m, h),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+                ("expert", "expert_mlp", "embed"),
+            ),
+            (e, h, m),
+            jnp.float32,
+        )
+
+        # --- routing (fp32 for a stable softmax) -------------------------
+        logits = jnp.einsum("gsm,me->gse", x.astype(jnp.float32), router)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)  # [g, s]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [g, s]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [g,s,e]
+
+        # capacity bucketing: position of each token in its expert's queue
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [g,s,e]; -1 if unrouted
+        pos_sel = jnp.sum(pos * onehot, axis=-1)  # [g,s] queue slot of the token
+        # one_hot is all-zero for slots >= c, so overflow drops out here
+        disp = jax.nn.one_hot(pos_sel.astype(jnp.int32), c, dtype=jnp.float32)
+        dispatch = onehot[..., None] * disp[:, :, None, :]  # [g,s,e,c]
+
+        # --- dispatch -> expert FFN -> combine ---------------------------
+        xe = jnp.einsum("gsec,gsm->gecm", dispatch, x.astype(jnp.float32))
+        hmid = jnp.einsum("gecm,emh->gech", xe.astype(cfg.dtype), wi.astype(cfg.dtype))
+        hmid = nn.gelu(hmid)
+        ye = jnp.einsum("gech,ehm->gecm", hmid, wo.astype(cfg.dtype))
+        combine = dispatch * gate[:, :, None, None]  # gate-weighted
+        y = jnp.einsum("gsec,gecm->gsm", combine, ye.astype(jnp.float32))
+
+        # --- switch load-balance aux loss --------------------------------
+        importance = jnp.mean(probs, axis=(0, 1))  # mean router prob per expert
+        load = jnp.mean(onehot, axis=(0, 1))  # fraction routed per expert
+        aux = e * jnp.sum(importance * load)
+
+        return y.astype(cfg.dtype), aux

@@ -1,0 +1,117 @@
+"""Pipeline parallelism over the ``pipeline`` mesh axis.
+
+SURVEY.md §2's PP row is ABSENT in the reference; here it is a
+first-class strategy: stages are laid out over the ``pipeline`` axis
+(slowest-varying — it spans DCN between slices in a multislice job,
+parallel/mesh.py), and microbatches stream through the classic GPipe
+schedule. The implementation is TPU-idiomatic:
+
+- one ``shard_map`` over the pipeline axis; each device holds its
+  stage's parameter slice (leading stage dim sharded over ``pipeline``);
+- a ``lax.fori_loop`` over ``num_micro + stages - 1`` ticks — static
+  trip count, single trace, no Python control flow;
+- stage handoff is ``lax.ppermute`` (neighbor ICI/DCN hop), compute and
+  the next tick's communication overlap under XLA's async collectives;
+- branchless stage selection via ``jnp.where`` on ``lax.axis_index``.
+
+The bubble fraction is (S-1)/(M+S-1) — callers pick microbatch counts
+M >> S. Output is gathered with a masked ``psum`` (only the last stage
+holds real outputs), keeping out_specs replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tfk8s_tpu.parallel.mesh import AXIS_PIPELINE
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of per-stage parameter pytrees along a new leading
+    'stage' dim (shard it over ``pipeline``)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,  # leaves [num_stages, ...]
+    microbatches: jax.Array,  # [num_micro, mb, ...]
+    mesh: Mesh,
+    axis: str = AXIS_PIPELINE,
+) -> jax.Array:
+    """Run ``y_i = stageS(...stage1(stage0(x_i)))`` for every microbatch
+    with stages executing in pipeline. ``stage_fn(stage_params, x) -> y``
+    must preserve x's shape (the inter-stage activation contract)."""
+    num_stages = mesh.shape[axis]
+    num_micro = microbatches.shape[0]
+
+    def body(params, mb):  # per-device: params [1, ...], mb [num_micro, ...]
+        params = jax.tree_util.tree_map(lambda x: x[0], params)
+        stage = lax.axis_index(axis)
+        ticks = num_micro + num_stages - 1
+        perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        mb_shape = mb.shape[1:]
+        zeros = jnp.zeros(mb_shape, mb.dtype)
+        outputs = jnp.zeros((num_micro,) + mb_shape, mb.dtype)
+
+        def compute(t, incoming, outputs):
+            # stage 0 pulls microbatch t (clamped; masked-out later)
+            first_in = lax.dynamic_index_in_dim(
+                mb, jnp.clip(t, 0, num_micro - 1), keepdims=False
+            )
+            x = jnp.where(stage == 0, first_in, incoming)
+            y = stage_fn(params, x)
+            # active iff 0 <= t - stage < num_micro
+            mu = t - stage
+            active = jnp.logical_and(mu >= 0, mu < num_micro)
+            y = jnp.where(active, y, zeros)
+            # last stage records its finished microbatch
+            is_last = stage == num_stages - 1
+            idx = jnp.clip(mu, 0, num_micro - 1)
+            rec = jnp.logical_and(is_last, active)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(rec, y, lax.dynamic_index_in_dim(outputs, idx, keepdims=False)),
+                idx,
+                axis=0,
+            )
+            return y, outputs
+
+        def tick(t, carry):
+            incoming, outputs = carry
+            y, outputs = compute(t, incoming, outputs)
+            # hand y to the next stage (non-circular shift)
+            return lax.ppermute(y, axis, perm), outputs
+
+        # the last tick's handoff would be dead traffic (possibly over
+        # DCN) — run it outside the loop without the permute
+        incoming, outputs = lax.fori_loop(0, ticks - 1, tick, (zeros, outputs))
+        _, outputs = compute(ticks - 1, incoming, outputs)
+        # only the last stage holds real outputs; masked psum replicates
+        outputs = jnp.where(stage == num_stages - 1, outputs, 0)
+        return lax.psum(outputs, axis)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, microbatches)
+
+
+def split_microbatches(x: jax.Array, num_micro: int) -> jax.Array:
+    """[batch, ...] -> [num_micro, batch/num_micro, ...]"""
+    assert x.shape[0] % num_micro == 0, (
+        f"batch {x.shape[0]} not divisible into {num_micro} microbatches"
+    )
+    return x.reshape((num_micro, x.shape[0] // num_micro) + x.shape[1:])

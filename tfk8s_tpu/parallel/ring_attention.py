@@ -1,0 +1,128 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+The long-context path the reference lacks entirely (SURVEY.md §2 'SP /
+CP / ring-attention' row, §5 'Long-context'): the sequence dimension is
+sharded over the ``sequence`` mesh axis; each device holds one Q/K/V
+block and K/V blocks rotate around the ring via ``lax.ppermute`` (one
+ICI hop per step — neighbor exchange, the cheapest collective on a TPU
+torus), while queries stay put. Softmax is accumulated online
+(flash-attention style running max / denominator), so the result is
+*exact* full attention with O(L/S) memory per device and compute/comm
+overlap XLA can pipeline.
+
+Blockwise compute is a ``lax.fori_loop`` (static trip count = ring size)
+— compiler-friendly control flow, one trace (SURVEY.md 'XLA semantics').
+
+Usage: ``make_ring_attn_fn(mesh)`` returns an ``attn_fn`` drop-in for
+``models/transformer.MultiHeadAttention`` — the blocks route through it
+whenever the job's mesh has a nontrivial sequence axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tfk8s_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+_NEG = -1e30
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [b, lq, h, d] local block, pre-scaled
+    k: jax.Array,  # [b, lk, h, d] local block
+    v: jax.Array,  # [b, lk, h, d]
+    axis_name: str,
+    causal: bool,
+) -> jax.Array:
+    """Per-device body under shard_map: rotate K/V around the ring,
+    accumulating the online softmax."""
+    ring = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+
+    qf = q.astype(jnp.float32)
+    q_pos = me * lq + jnp.arange(lq)  # global query positions
+
+    # carries: running max m [b,h,lq], denom l [b,h,lq], out o [b,lq,h,d]
+    m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    o0 = jnp.zeros((b, lq, h, d), jnp.float32)
+
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def process_block(t, m, l, o, kt, vt):
+        # block now held originated on shard (me - t) mod ring
+        src = (me - t) % ring
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kt.astype(jnp.float32)
+        )
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            cm = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(cm[None, None], scores, _NEG)
+        blk_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vt.astype(jnp.float32)
+        )
+        return m_new, l_new, o_new
+
+    def body(t, carry):
+        m, l, o, kt, vt = carry
+        m, l, o = process_block(t, m, l, o, kt, vt)
+        k_next = lax.ppermute(kt, axis_name, perm)
+        v_next = lax.ppermute(vt, axis_name, perm)
+        return m, l, o, k_next, v_next
+
+    # ring-1 rotate+process iterations; the final held block needs no
+    # outgoing permute (it would be dead traffic on ICI)
+    m, l, o, kt, vt = lax.fori_loop(0, ring - 1, body, (m0, l0, o0, k, v))
+    m, l, o = process_block(ring - 1, m, l, o, kt, vt)
+    # fully-masked rows (causal, early ring slots) have l == 0; output 0
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def make_ring_attn_fn(mesh: Mesh, seq_axis: str = AXIS_SEQUENCE):
+    """Build an ``attn_fn(q, k, v, mask=None, causal=False)`` that runs
+    ring attention with batch over data(+fsdp), heads over tensor, and
+    sequence over ``seq_axis``. Requires mask=None (padding masks would
+    need per-block mask rotation — synthetic pretraining data is unpadded)."""
+    batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
+    head_axis = AXIS_TENSOR if AXIS_TENSOR in mesh.axis_names else None
+    bspec = batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None)
+    spec = P(bspec, seq_axis, head_axis, None)
+
+    def attn_fn(q, k, v, mask=None, causal=False):
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention: padding masks not supported; pass mask=None"
+            )
+        inner = shard_map(
+            functools.partial(
+                _ring_attention_local, axis_name=seq_axis, causal=causal
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return inner(q, k, v)
+
+    return attn_fn

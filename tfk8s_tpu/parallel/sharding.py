@@ -45,6 +45,11 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("expert_mlp", AXIS_TENSOR),
     ("stack", None),
     ("norm", None),
+    # conv kernels (h, w, in, out): spatial+input replicated, output
+    # channels sharded like a kernel's output dim under FSDP
+    ("conv_k", None),
+    ("conv_in", None),
+    ("conv_out", AXIS_FSDP),
 )
 
 

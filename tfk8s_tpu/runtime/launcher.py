@@ -84,6 +84,10 @@ def initialize_distributed(ctx: ProcessContext, env: Optional[Dict[str, str]] = 
     e = dict(os.environ) if env is None else env
     if ctx.num_processes <= 1 or e.get("TFK8S_DISTRIBUTED") != "1":
         return
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized (idempotent re-entry)
     log.info(
         "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
         ctx.coordinator_address, ctx.num_processes, ctx.process_id,

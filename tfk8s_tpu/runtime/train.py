@@ -162,15 +162,18 @@ class Trainer:
                 metrics,
             )
 
+        self.batch_shardings = self._batch_shardings()
         self._step_fn = jax.jit(
             _step,
-            in_shardings=(self.state_shardings, self._batch_shardings(), None),
+            in_shardings=(self.state_shardings, self.batch_shardings, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,),
         )
 
     def _batch_shardings(self):
-        """Batch leaves shard dim 0 over data(+fsdp); scalars replicate."""
+        """Batch leaves shard dim 0 over data(+fsdp); scalars replicate.
+        Computed once in _build (synthesizes a throwaway example batch);
+        use the cached ``batch_shardings`` afterwards."""
         example = self.task.make_batch(np.random.default_rng(0), self.task.batch_size)
 
         def one(leaf):
@@ -211,7 +214,7 @@ class Trainer:
         np_rng = np.random.default_rng(cfg.seed + int(state.step))
         history: List[Dict[str, float]] = []
         start_step = int(state.step)
-        batch_shardings = self._batch_shardings()
+        batch_shardings = self.batch_shardings
 
         t0 = time.perf_counter()
         for step in range(start_step, cfg.steps):
@@ -243,15 +246,19 @@ def run_task(
     env: Optional[Dict[str, str]] = None,
     stop: Optional[Any] = None,
     config: Optional[TrainConfig] = None,
+    mesh: Optional[Mesh] = None,
 ) -> Dict[str, float]:
     """Entrypoint glue: env contract -> mesh -> (resume ->) fit -> metrics.
     Raises if the task declares convergence targets and misses them — a
     failed pod is how the control plane learns training went wrong
-    (SURVEY.md §3.5)."""
+    (SURVEY.md §3.5). Pass ``mesh`` when the caller already built it (e.g.
+    to construct a mesh-bound attention fn); it must match the env's
+    TFK8S_MESH contract."""
     env = dict(env or {})
     ctx = ProcessContext.from_env(env)
     initialize_distributed(ctx, env)
-    mesh = build_mesh(ctx)
+    if mesh is None:
+        mesh = build_mesh(ctx)
 
     if config is None:
         config = TrainConfig(
