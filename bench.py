@@ -53,23 +53,35 @@ def main() -> None:
     rng = np.random.default_rng(0)
     # Pre-stage batches on device: the benchmark measures the training
     # step (the thing the metric is defined over), not the synthetic-data
-    # host pipeline / tunnel transfer.
-    batches = [
-        jax.device_put(task.make_batch(rng, task.batch_size), shardings)
-        for _ in range(4)
-    ]
+    # host pipeline / tunnel transfer. All timed steps run inside ONE
+    # jitted lax.scan — a single dispatch with a strict device-side
+    # dependency chain, immune to async-dispatch timing artifacts.
+    import jax.numpy as jnp
 
-    def step(state, i):
-        return trainer._step_fn(state, batches[i % len(batches)], jax.random.key(i))
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    for i in range(warmup):
-        state, metrics = step(state, i)
-    jax.block_until_ready(metrics["loss"])
+    host = [task.make_batch(rng, task.batch_size) for _ in range(4)]
+    stacked = jax.device_put(
+        jax.tree_util.tree_map(lambda *xs: np.stack(xs), *host),
+        jax.tree_util.tree_map(
+            lambda s: NamedSharding(s.mesh, P(None, *s.spec)), shardings
+        ),
+    )
+
+    def run_n(state, n):
+        def body(s, i):
+            batch = jax.tree_util.tree_map(lambda x: x[i % 4], stacked)
+            s, metrics = trainer._step_fn(s, batch, jax.random.fold_in(jax.random.key(0), i))
+            return s, metrics["loss"]
+        return jax.lax.scan(body, state, jnp.arange(n))
+
+    run = jax.jit(run_n, static_argnums=1)
+    state, losses = run(state, warmup)  # compile + warm
+    jax.block_until_ready(losses)
 
     t0 = time.perf_counter()
-    for i in range(warmup, warmup + steps):
-        state, metrics = step(state, i)
-    jax.block_until_ready(metrics["loss"])
+    state, losses = run(state, steps)
+    jax.block_until_ready(losses)
     dt = time.perf_counter() - t0
 
     images_per_sec = task.batch_size * steps / dt

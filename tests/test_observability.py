@@ -1,0 +1,140 @@
+"""Observability + manifest tests: metrics histograms and Prometheus
+exposition, the /metrics//healthz//events HTTP endpoint, reconcile
+latency recording, YAML manifest submission (SURVEY.md §5 — all marked
+ABSENT in the reference, added by the build; C20 CRD manifest)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+from tfk8s_tpu.cmd.main import load_manifest, main
+from tfk8s_tpu.cmd.options import Options
+from tfk8s_tpu.cmd.server import Server
+from tfk8s_tpu.runtime import registry
+from tfk8s_tpu.utils.logging import Metrics
+
+DONE = {}
+
+
+@registry.register("obstest.echo")
+def _echo(env):
+    DONE[env["TFK8S_JOB_NAME"]] = True
+
+
+def test_metrics_histogram_and_prometheus_text():
+    m = Metrics()
+    m.inc("op.syncs", 3)
+    m.set_gauge("op.depth", 7)
+    for v in (0.002, 0.02, 0.2, 2.0, 20.0):
+        m.observe("op.sync_seconds", v)
+    snap = m.snapshot()
+    assert snap["counters"]["op.syncs"] == 3
+    assert snap["histograms"]["op.sync_seconds"]["count"] == 5
+    assert abs(snap["histograms"]["op.sync_seconds"]["sum"] - 22.222) < 1e-6
+    text = m.prometheus_text()
+    assert "op_syncs 3" in text
+    assert "op_depth 7" in text
+    assert 'op_sync_seconds_bucket{le="+Inf"} 5' in text
+    assert "op_sync_seconds_count 5" in text
+
+
+def test_metrics_endpoint_serves_job_metrics():
+    opts = Options(workers=1)
+    server = Server(opts)
+    stop = threading.Event()
+    port = server.start_metrics_server(0)
+    server.run(stop, block=False)
+    try:
+        code = _submit_and_wait(server, "obsjob")
+        assert code
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "tpujob_syncs" in body
+        assert "tpujob_sync_seconds_bucket" in body  # reconcile latency histogram
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read()
+        assert health == b"ok"
+        events = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events", timeout=5
+            ).read()
+        )
+        assert any(e["reason"] == "JobSucceeded" for e in events)
+    finally:
+        stop.set()
+        server.shutdown()
+
+
+def _submit_and_wait(server, name, timeout=20):
+    from tfk8s_tpu.api import helpers
+    from tfk8s_tpu.api.types import (
+        ContainerSpec, JobConditionType, ObjectMeta, ReplicaSpec, ReplicaType,
+        TPUJob, TPUJobSpec, TPUSpec,
+    )
+
+    job = TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint="obstest.echo")
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+        ),
+    )
+    server.clientset.tpujobs("default").create(job)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        cur = server.clientset.tpujobs("default").get(name)
+        if helpers.has_condition(cur.status, JobConditionType.SUCCEEDED):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_load_manifest_yaml():
+    from tfk8s_tpu.api.types import ReplicaType, TPUJob
+
+    job = load_manifest("manifests/examples/bert-v5p32.yaml")
+    assert isinstance(job, TPUJob)
+    assert job.metadata.name == "bert-base-mlm"
+    spec = job.spec.replica_specs[ReplicaType.WORKER]
+    assert spec.replicas == 4
+    assert spec.template.entrypoint == "tfk8s_tpu.models.bert:train"
+    assert job.spec.mesh.axes == {"data": 8, "fsdp": 2}
+    assert job.spec.tpu.accelerator == "v5p-32"
+    # the example must validate after defaulting
+    from tfk8s_tpu.api import set_defaults, validate
+
+    assert validate(set_defaults(job)) == []
+
+
+def test_run_subcommand_with_manifest_file(tmp_path):
+    DONE.clear()
+    manifest = tmp_path / "job.yaml"
+    manifest.write_text(
+        """
+kind: TPUJob
+metadata:
+  name: filejob
+spec:
+  replica_specs:
+    Worker:
+      replicas: 1
+      template:
+        entrypoint: obstest.echo
+  tpu:
+    accelerator: cpu-1
+"""
+    )
+    code = main(["run", "--file", str(manifest), "--timeout", "30"])
+    assert code == 0
+    assert DONE.get("filejob")
+
+
+def test_run_requires_file_or_entrypoint():
+    assert main(["run", "--timeout", "1"]) == 2

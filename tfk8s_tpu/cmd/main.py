@@ -36,9 +36,11 @@ def _build_parser() -> argparse.ArgumentParser:
 
     p_run = sub.add_parser("run", help="run one TPUJob end-to-end locally")
     Options.add_flags(p_run)
+    p_run.add_argument("--file", default="",
+                       help="TPUJob manifest (YAML or JSON); overrides the flag-built spec")
     p_run.add_argument("--name", default="job")
-    p_run.add_argument("--entrypoint", required=True,
-                       help='e.g. "tfk8s_tpu.models.mlp:train"')
+    p_run.add_argument("--entrypoint", default="",
+                       help='e.g. "tfk8s_tpu.models.mlp:train" (required without --file)')
     p_run.add_argument("--replicas", type=int, default=1)
     p_run.add_argument("--accelerator", default="cpu-1")
     p_run.add_argument("--env", default="{}",
@@ -76,34 +78,45 @@ def _cmd_run(opts: Options, args: argparse.Namespace) -> int:
     )
     from tfk8s_tpu.cmd.server import Server
 
+    from tfk8s_tpu.api import serde
+
+    if args.file:
+        job = load_manifest(args.file)
+        if job.metadata.namespace != opts.namespace:
+            job.metadata.namespace = opts.namespace
+    elif args.entrypoint:
+        job = TPUJob(
+            metadata=ObjectMeta(name=args.name, namespace=opts.namespace),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=args.replicas,
+                        template=ContainerSpec(
+                            entrypoint=args.entrypoint,
+                            env=json.loads(args.env or "{}"),
+                        ),
+                    )
+                },
+                tpu=TPUSpec(accelerator=args.accelerator),
+                run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+            ),
+        )
+    else:
+        log.error("run: one of --file or --entrypoint is required")
+        return 2
+
     stop = threading.Event()
     server = Server(opts)
     server.run(stop, block=False)
-
-    job = TPUJob(
-        metadata=ObjectMeta(name=args.name, namespace=opts.namespace),
-        spec=TPUJobSpec(
-            replica_specs={
-                ReplicaType.WORKER: ReplicaSpec(
-                    replicas=args.replicas,
-                    template=ContainerSpec(
-                        entrypoint=args.entrypoint,
-                        env=json.loads(args.env or "{}"),
-                    ),
-                )
-            },
-            tpu=TPUSpec(accelerator=args.accelerator),
-            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
-        ),
-    )
+    name = job.metadata.name
     server.clientset.tpujobs(opts.namespace).create(job)
-    log.info("submitted %s/%s; waiting for completion", opts.namespace, args.name)
+    log.info("submitted %s/%s; waiting for completion", opts.namespace, name)
 
     deadline = time.time() + args.timeout
     code = 1
     while time.time() < deadline:
         try:
-            cur = server.clientset.tpujobs(opts.namespace).get(args.name)
+            cur = server.clientset.tpujobs(opts.namespace).get(name)
         except Exception:
             time.sleep(0.2)
             continue
@@ -122,6 +135,21 @@ def _cmd_run(opts: Options, args: argparse.Namespace) -> int:
     stop.set()
     server.shutdown()
     return code
+
+
+def load_manifest(path: str):
+    """Decode a TPUJob (or any scheme kind) from a YAML/JSON manifest."""
+    from tfk8s_tpu.api import serde
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+
+        data = yaml.safe_load(text)
+    except ImportError:  # pragma: no cover — pyyaml is baked in
+        data = json.loads(text)
+    return serde.decode_object(data)
 
 
 def _cmd_train(args: argparse.Namespace) -> int:

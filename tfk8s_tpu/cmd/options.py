@@ -35,6 +35,8 @@ class Options:
     capacity: Dict[str, int] = dataclasses.field(default_factory=dict)
     # run the in-process kubelet (hermetic/local backend)
     local_kubelet: bool = True
+    # observability endpoint (/metrics, /healthz, /events); 0 = disabled
+    metrics_port: int = 0
     # logging
     log_level: str = "info"
 
@@ -66,6 +68,8 @@ class Options:
         g.add_argument("--no-local-kubelet", action="store_false",
                        dest="local_kubelet",
                        help="do not run the in-process pod executor")
+        g.add_argument("--metrics-port", type=int, default=0, dest="metrics_port",
+                       help="serve /metrics, /healthz, /events on this port (0=off)")
         g.add_argument("--log-level", default="info",
                        choices=["debug", "info", "warning", "error"])
 
@@ -86,6 +90,7 @@ class Options:
             identity=args.identity,
             capacity=capacity,
             local_kubelet=args.local_kubelet,
+            metrics_port=args.metrics_port,
             log_level=args.log_level,
         )
 

@@ -11,6 +11,8 @@ step 2).
 
 from __future__ import annotations
 
+import http.server
+import json
 import threading
 from typing import Optional
 
@@ -47,11 +49,60 @@ class Server:
         )
         self.kubelet = LocalKubelet(self.clientset) if opts.local_kubelet else None
         self._threads: list = []
+        self._http: Optional[http.server.ThreadingHTTPServer] = None
+
+    # -- observability endpoint (SURVEY.md §5: absent in the reference;
+    #    /metrics Prometheus text, /healthz, /events JSON) ---------------
+
+    def start_metrics_server(self, port: int) -> int:
+        """Bind and serve on a daemon thread; returns the bound port
+        (useful with port=0 in tests)."""
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = server.metrics.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body = b"ok"
+                    ctype = "text/plain"
+                elif self.path == "/events":
+                    body = json.dumps(
+                        [
+                            {
+                                "ts": e.timestamp, "kind": e.kind, "key": e.key,
+                                "reason": e.reason, "message": e.message,
+                            }
+                            for e in server.recorder.events()
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._http = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        t = threading.Thread(target=self._http.serve_forever, daemon=True, name="metrics-http")
+        t.start()
+        self._threads.append(t)
+        return self._http.server_address[1]
 
     def run(self, stop: threading.Event, block: bool = True) -> None:
         """Start kubelet + controller (possibly behind the leader gate).
         With ``block=False`` returns once everything is started."""
         init_logging(self.opts.log_level_int())
+        if self.opts.metrics_port:
+            port = self.start_metrics_server(self.opts.metrics_port)
+            log.info("metrics endpoint on 127.0.0.1:%d", port)
         if self.kubelet:
             self.kubelet.run(stop)  # informer-driven; returns immediately
 
@@ -88,4 +139,6 @@ class Server:
             stop.wait()
 
     def shutdown(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
         self.controller.controller.shutdown()

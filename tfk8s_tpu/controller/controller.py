@@ -20,6 +20,7 @@ Controllers supply ``sync(key)``; everything else is shared.
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from typing import Callable, List, Optional, Sequence
 
@@ -131,6 +132,7 @@ class Controller:
                 return
             if key is None:
                 continue
+            t0 = time.perf_counter()
             try:
                 self.sync(key)
             except Exception as e:  # noqa: BLE001 — one bad key must not kill the worker
@@ -155,4 +157,7 @@ class Controller:
                 self.metrics.inc(f"{self.name}.syncs")
                 self.queue.forget(key)
             finally:
+                self.metrics.observe(
+                    f"{self.name}.sync_seconds", time.perf_counter() - t0
+                )
                 self.queue.done(key)

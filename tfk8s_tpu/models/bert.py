@@ -149,8 +149,9 @@ def task_for_mesh(
     **task_kw,
 ) -> TrainTask:
     """Build the task with the attention impl the mesh calls for: ring
-    attention whenever the mesh has a nontrivial ``sequence`` axis or the
-    config asks for it explicitly (cfg.attention_impl == 'ring')."""
+    attention whenever the mesh has a nontrivial ``sequence`` axis (or
+    cfg.attention_impl == 'ring'); the pallas flash kernel when
+    cfg.attention_impl == 'flash'."""
     from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
     from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
 
@@ -161,6 +162,10 @@ def task_for_mesh(
     attn_fn = None
     if cfg.attention_impl == "ring" or seq_sharded:
         attn_fn = make_ring_attn_fn(mesh)
+    elif cfg.attention_impl == "flash":
+        from tfk8s_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = flash_attention
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 

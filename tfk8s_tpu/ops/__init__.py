@@ -1,0 +1,6 @@
+"""Hand-written TPU kernels (pallas) for hot ops the XLA autofuser
+doesn't already win on. The reference has no numerical code at all
+(SURVEY.md §2: operator treats training as a black box) — this layer is
+the build's TPU-native data-plane addition."""
+
+from tfk8s_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -78,6 +78,11 @@ class TrainConfig:
     seed: int = 0
     resume: bool = False
     optimizer: Optional[optax.GradientTransformation] = None
+    # jax.profiler trace output dir (SURVEY.md §5 'Tracing: ABSENT' in the
+    # reference — the build's addition); empty disables
+    profile_dir: str = ""
+    profile_skip: int = 3  # steps to skip (compile/warmup) before tracing
+    profile_steps: int = 5  # traced step count
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer is not None:
@@ -216,14 +221,26 @@ class Trainer:
         start_step = int(state.step)
         batch_shardings = self.batch_shardings
 
+        prof_start = start_step + cfg.profile_skip if cfg.profile_dir else -1
+        prof_stop = prof_start + cfg.profile_steps
+        profiling = False
+
         t0 = time.perf_counter()
         for step in range(start_step, cfg.steps):
             if stop is not None and getattr(stop, "is_set", lambda: False)():
                 log.info("%s: stop requested at step %d", self.task.name, step)
                 break
+            if step == prof_start:
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
             host_batch = self.task.make_batch(np_rng, self.task.batch_size)
             batch = jax.device_put(host_batch, batch_shardings)
             state, metrics = self._step_fn(state, batch, jax.random.fold_in(jax.random.key(cfg.seed), step))
+            if profiling and step + 1 >= prof_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
+                log.info("%s: profile trace written to %s", self.task.name, cfg.profile_dir)
             if ckpt and cfg.checkpoint_every and (step + 1) % cfg.checkpoint_every == 0:
                 ckpt.save(step + 1, state)
             if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
@@ -235,6 +252,8 @@ class Trainer:
                     "%s step %d: %s", self.task.name, step + 1,
                     {k: round(v, 4) for k, v in m.items()},
                 )
+        if profiling:  # run ended inside the trace window
+            jax.profiler.stop_trace()
         if ckpt and ckpt.enabled:
             ckpt.save(int(state.step), state, wait=True)
             ckpt.close()
@@ -269,6 +288,7 @@ def run_task(
             checkpoint_dir=ctx.checkpoint_dir,
             seed=int(env.get("TFK8S_SEED", "0")),
             resume=ctx.resuming,
+            profile_dir=env.get("TFK8S_PROFILE_DIR", ""),
         )
 
     trainer = Trainer(task, config, mesh)

@@ -308,12 +308,17 @@ class TPUJobController:
                 message=f"restart {job.status.gang_restarts} after "
                 f"{[p.metadata.name for p in failed]} failed",
             )
+            # Persist the restart count BEFORE deleting pods: if this write
+            # conflicts, stop here — the failed pods are still observable,
+            # so the re-enqueued sync redoes the accounting. Deleting first
+            # would lose the increment on conflict (restart without trace).
+            if not self._write_status(job):
+                return True
             self.recorder.event(
                 "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
             )
             self.metrics.inc("tpujob.gang_restarts")
             self._delete_job_pods(job, only_phases=None)
-            self._write_status(job)
             return True
 
         # Per-pod in-place restart (OnFailure/Always/ExitCode)
@@ -424,15 +429,19 @@ class TPUJobController:
         if changed:
             self._write_status(job)
 
-    def _write_status(self, job: TPUJob) -> None:
+    def _write_status(self, job: TPUJob) -> bool:
+        """Returns True when the write landed; False on conflict/deletion
+        (the watch delivers the fresh object and re-enqueues)."""
         try:
             self.cs.tpujobs(job.metadata.namespace).update_status(job)
+            return True
         except Conflict:
             # Stale copy: the watch will deliver the fresh object and the
             # controller re-enqueues — the canonical conflict path.
             self.controller.enqueue_key(job.metadata.key)
+            return False
         except NotFound:
-            pass
+            return False
 
     # ------------------------------------------------------ teardown paths
 

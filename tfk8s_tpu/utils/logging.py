@@ -67,14 +67,21 @@ class EventRecorder:
             ]
 
 
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+
 class Metrics:
-    """Minimal counter/gauge registry (SURVEY.md §5: 'no metrics endpoint
-    evidenced' in the reference — this is the build's addition)."""
+    """Counter/gauge/histogram registry with Prometheus text exposition
+    (SURVEY.md §5: 'no metrics endpoint evidenced' in the reference —
+    this is the build's addition)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
+        # name -> [bucket counts..., +inf count], plus _sum/_count
+        self.hist_counts: Dict[str, List[float]] = {}
+        self.hist_sum: Dict[str, float] = {}
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -84,6 +91,57 @@ class Metrics:
         with self._lock:
             self.gauges[name] = value
 
+    def observe(self, name: str, value: float) -> None:
+        """Record one histogram observation (e.g. a sync latency)."""
+        with self._lock:
+            counts = self.hist_counts.setdefault(
+                name, [0.0] * (len(_DEFAULT_BUCKETS) + 1)
+            )
+            for i, ub in enumerate(_DEFAULT_BUCKETS):
+                if value <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self.hist_sum[name] = self.hist_sum.get(name, 0.0) + value
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            return {"counters": dict(self.counters), "gauges": dict(self.gauges)}
+            hists = {}
+            for name, counts in self.hist_counts.items():
+                hists[name] = {
+                    "count": sum(counts),
+                    "sum": self.hist_sum.get(name, 0.0),
+                }
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": hists,
+            }
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format; metric names sanitized
+        (dots -> underscores)."""
+        def san(n: str) -> str:
+            return n.replace(".", "_").replace("-", "_")
+
+        with self._lock:
+            lines: List[str] = []
+            for name, v in sorted(self.counters.items()):
+                lines.append(f"# TYPE {san(name)} counter")
+                lines.append(f"{san(name)} {v}")
+            for name, v in sorted(self.gauges.items()):
+                lines.append(f"# TYPE {san(name)} gauge")
+                lines.append(f"{san(name)} {v}")
+            for name, counts in sorted(self.hist_counts.items()):
+                n = san(name)
+                lines.append(f"# TYPE {n} histogram")
+                cum = 0.0
+                for i, ub in enumerate(_DEFAULT_BUCKETS):
+                    cum += counts[i]
+                    lines.append(f'{n}_bucket{{le="{ub}"}} {cum}')
+                cum += counts[-1]
+                lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{n}_sum {self.hist_sum.get(name, 0.0)}")
+                lines.append(f"{n}_count {cum}")
+            return "\n".join(lines) + "\n"
