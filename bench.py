@@ -1,6 +1,8 @@
-"""Headline benchmark: ResNet-50 images/sec/chip (BASELINE.json "metric").
+"""Headline benchmark: ResNet-50 images/sec/chip (BASELINE.json "metric"),
+plus BERT-base MLM step-time — the second BASELINE.md target metric.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with the
+secondary BERT measurement under "extra".
 
 The reference publishes no numbers (`BASELINE.json "published": {}`,
 SURVEY.md §6), so ``vs_baseline`` compares against the last recorded run
@@ -9,6 +11,13 @@ the first measurement.
 
 Runs on whatever backend JAX finds: the driver runs it on the one real
 TPU chip; set BENCH_SMALL=1 for a seconds-scale CPU smoke run.
+
+All timed steps run inside ONE jitted ``lax.scan`` — a single dispatch
+with a strict device-side dependency chain, immune to async-dispatch
+timing artifacts. Pre-staged batches are passed as a jit ARGUMENT (never
+captured in the closure: closed-over device arrays are baked into the HLO
+as constants, which bloats the program by hundreds of MB and broke the
+round-1 remote compile with HTTP 413).
 """
 
 from __future__ import annotations
@@ -21,46 +30,21 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def main() -> None:
+def _time_task(task, mesh, steps: int, n_stage: int = 4) -> float:
+    """Seconds per training step, measured over ``steps`` scanned steps."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tfk8s_tpu.models import resnet
-    from tfk8s_tpu.parallel.mesh import make_mesh
     from tfk8s_tpu.runtime.train import TrainConfig, Trainer
 
-    small = os.environ.get("BENCH_SMALL") == "1"
-    if small:
-        task = resnet.make_task(
-            depth=18, num_classes=8, image_size=32, batch_size=8, width=8
-        )
-        steps, warmup = 8, 3
-    else:
-        task = resnet.make_task(
-            depth=50,
-            num_classes=1000,
-            image_size=224,
-            batch_size=int(os.environ.get("BENCH_BATCH", "128")),
-        )
-        steps, warmup = 30, 10
-
-    n_chips = jax.device_count()
-    mesh = make_mesh(data=n_chips)
     trainer = Trainer(task, TrainConfig(steps=steps, learning_rate=1e-3), mesh)
     state = trainer.init_state()
     shardings = trainer.batch_shardings
     rng = np.random.default_rng(0)
-    # Pre-stage batches on device: the benchmark measures the training
-    # step (the thing the metric is defined over), not the synthetic-data
-    # host pipeline / tunnel transfer. All timed steps run inside ONE
-    # jitted lax.scan — a single dispatch with a strict device-side
-    # dependency chain, immune to async-dispatch timing artifacts.
-    import jax.numpy as jnp
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    host = [task.make_batch(rng, task.batch_size) for _ in range(4)]
+    host = [task.make_batch(rng, task.batch_size) for _ in range(n_stage)]
     stacked = jax.device_put(
         jax.tree_util.tree_map(lambda *xs: np.stack(xs), *host),
         jax.tree_util.tree_map(
@@ -68,24 +52,74 @@ def main() -> None:
         ),
     )
 
-    def run_n(state, n):
+    def run_n(state, staged, n):
         def body(s, i):
-            batch = jax.tree_util.tree_map(lambda x: x[i % 4], stacked)
-            s, metrics = trainer._step_fn(s, batch, jax.random.fold_in(jax.random.key(0), i))
+            batch = jax.tree_util.tree_map(lambda x: x[i % n_stage], staged)
+            s, metrics = trainer._step_fn(
+                s, batch, jax.random.fold_in(jax.random.key(0), i)
+            )
             return s, metrics["loss"]
+
         return jax.lax.scan(body, state, jnp.arange(n))
 
-    run = jax.jit(run_n, static_argnums=1)
-    state, losses = run(state, warmup)  # compile + warm
-    jax.block_until_ready(losses)
+    run = jax.jit(run_n, static_argnums=2)
+    # Warm with the SAME static n as the timed call — a different scan
+    # length is a different HLO, and the recompile would land inside the
+    # timed region. Fetch a loss to the host to force completion: through
+    # the remote-execution tunnel block_until_ready can return before the
+    # device work drains, so a host transfer is the only honest barrier.
+    state, losses = run(state, stacked, steps)  # compile + warm
+    float(np.asarray(losses)[-1])
 
     t0 = time.perf_counter()
-    state, losses = run(state, steps)
-    jax.block_until_ready(losses)
-    dt = time.perf_counter() - t0
+    state, losses = run(state, stacked, steps)
+    float(np.asarray(losses)[-1])
+    return (time.perf_counter() - t0) / steps
 
-    images_per_sec = task.batch_size * steps / dt
-    value = images_per_sec / n_chips
+
+def main() -> None:
+    import jax
+
+    from tfk8s_tpu.models import bert, resnet
+    from tfk8s_tpu.parallel.mesh import make_mesh
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n_chips = jax.device_count()
+    mesh = make_mesh(data=n_chips)
+
+    # -- headline: ResNet-50 images/sec/chip --------------------------------
+    if small:
+        rn_task = resnet.make_task(
+            depth=18, num_classes=8, image_size=32, batch_size=8, width=8
+        )
+        steps = 8
+    else:
+        rn_task = resnet.make_task(
+            depth=50,
+            num_classes=1000,
+            image_size=224,
+            batch_size=int(os.environ.get("BENCH_BATCH", "128")),
+        )
+        steps = 30
+    sec_per_step = _time_task(rn_task, mesh, steps)
+    value = rn_task.batch_size / sec_per_step / n_chips
+
+    # -- secondary: BERT-base MLM step-time (BASELINE.md row 2) -------------
+    if small:
+        bert_seq = 32
+        bert_task = bert.make_task(
+            cfg=bert.tiny_config(), seq_len=bert_seq, batch_size=8
+        )
+        bsteps = 8
+    else:
+        bert_seq = int(os.environ.get("BENCH_BERT_SEQ", "128"))
+        bert_task = bert.make_task(
+            cfg=bert.base_config(),
+            seq_len=bert_seq,
+            batch_size=int(os.environ.get("BENCH_BERT_BATCH", "64")),
+        )
+        bsteps = 20
+    bert_sec = _time_task(bert_task, mesh, bsteps)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
@@ -104,6 +138,13 @@ def main() -> None:
                 "value": round(value, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(vs, 4),
+                "extra": {
+                    "bert_base_mlm_step_time_ms": round(bert_sec * 1000, 3),
+                    "bert_batch_size": bert_task.batch_size,
+                    "bert_seq_len": bert_seq,
+                    "resnet_batch_size": rn_task.batch_size,
+                    "n_chips": n_chips,
+                },
             }
         )
     )

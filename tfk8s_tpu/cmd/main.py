@@ -78,11 +78,13 @@ def _cmd_run(opts: Options, args: argparse.Namespace) -> int:
     )
     from tfk8s_tpu.cmd.server import Server
 
-    from tfk8s_tpu.api import serde
-
     if args.file:
         job = load_manifest(args.file)
         if job.metadata.namespace != opts.namespace:
+            log.warning(
+                "run: overriding manifest namespace %r with --namespace %r",
+                job.metadata.namespace, opts.namespace,
+            )
             job.metadata.namespace = opts.namespace
     elif args.entrypoint:
         job = TPUJob(

@@ -13,7 +13,9 @@ import argparse
 import dataclasses
 import json
 import logging
+import os
 import socket
+import uuid
 from typing import Dict, Optional
 
 
@@ -42,7 +44,12 @@ class Options:
 
     def __post_init__(self):
         if not self.identity:
-            self.identity = f"{socket.gethostname()}-{id(self) & 0xFFFF:x}"
+            # pid + random suffix: unique across processes on one host
+            # (two identical operator processes routinely get the same
+            # object address, so id(self) would collide)
+            self.identity = (
+                f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+            )
 
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:

@@ -41,7 +41,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool):
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+    # Bottom-right-aligned causal mask (matches _reference's tril with
+    # k=lk-lq): query i may see keys up to i + (lk - lq). With lq == lk
+    # the offset is 0 (ordinary self-attention).
+    lq_total = pl.num_programs(1) * block_q
+    offset = seq_len - lq_total
+    q_pos = offset + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
 
@@ -66,9 +71,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool):
 
     if causal:
         # K blocks strictly above the diagonal contribute nothing — skip:
-        # the last needed block holds key index (qi+1)*block_q - 1
+        # the last needed block holds key index offset + (qi+1)*block_q - 1
         num_kb_eff = jnp.minimum(
-            num_kb, ((qi + 1) * block_q - 1) // block_k + 1
+            num_kb, (offset + (qi + 1) * block_q - 1) // block_k + 1
         )
     else:
         num_kb_eff = num_kb
@@ -158,5 +163,13 @@ def flash_attention(
     if mask is not None:
         raise NotImplementedError(
             "flash attention: padding masks not supported; pass mask=None"
+        )
+    if causal and q.shape[1] > k.shape[1]:
+        # lq > lk leaves some query rows with zero visible keys, where the
+        # all-masked-softmax semantics of the kernel (zero output) and the
+        # XLA reference (uniform) diverge — a degenerate case; reject it.
+        raise ValueError(
+            f"causal flash attention requires lq <= lk, got lq={q.shape[1]} "
+            f"lk={k.shape[1]}"
         )
     return _flash(q, k, v, causal, block_q, block_k)

@@ -84,19 +84,24 @@ def initialize_distributed(ctx: ProcessContext, env: Optional[Dict[str, str]] = 
     e = dict(os.environ) if env is None else env
     if ctx.num_processes <= 1 or e.get("TFK8S_DISTRIBUTED") != "1":
         return
-    from jax._src import distributed as _dist
-
-    if getattr(_dist.global_state, "client", None) is not None:
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
         return  # already initialized (idempotent re-entry)
     log.info(
         "jax.distributed.initialize(coordinator=%s, num_processes=%d, process_id=%d)",
         ctx.coordinator_address, ctx.num_processes, ctx.process_id,
     )
-    jax.distributed.initialize(
-        coordinator_address=ctx.coordinator_address,
-        num_processes=ctx.num_processes,
-        process_id=ctx.process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator_address,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+    except RuntimeError as exc:
+        # older JAX without is_initialized(): double-init raises here
+        if "already initialized" not in str(exc).lower():
+            raise
+        log.info("jax.distributed already initialized; continuing")
 
 
 def build_mesh(ctx: ProcessContext):

@@ -270,6 +270,10 @@ class TPUJobController:
 
         self._update_job_status(job, status_changed)
 
+    @staticmethod
+    def _gang_restart_message(restart_no: int, failed_ids: List[str]) -> str:
+        return f"restart {restart_no} after {failed_ids} failed"
+
     def _handle_failures(self, job: TPUJob, failed: List[Pod], observed) -> bool:
         """Returns True when reconcile should stop (terminal / restarting)."""
         key = job.metadata.key
@@ -301,23 +305,45 @@ class TPUJobController:
                 self.recorder.event("TPUJob", key, "BackoffLimitExceeded")
                 self._write_status(job)
                 return True
-            job.status.gang_restarts += 1
-            helpers.set_condition(
-                job.status, JobConditionType.RESTARTING,
-                reason="GangRestart",
-                message=f"restart {job.status.gang_restarts} after "
-                f"{[p.metadata.name for p in failed]} failed",
+            # Idempotent accounting: if a crash landed between the status
+            # write and pod deletion, the next sync re-observes the same
+            # failed pods with the RESTARTING condition already recorded —
+            # don't burn a second unit of backoff_limit, just finish the
+            # deletion. Keyed by pod UID (not name): recreated pods reuse
+            # names but get fresh UIDs, so a genuine repeat failure is a
+            # new episode and still counts against backoff_limit.
+            failed_ids = sorted(
+                f"{p.metadata.name}:{p.metadata.uid[:8]}" for p in failed
             )
-            # Persist the restart count BEFORE deleting pods: if this write
-            # conflicts, stop here — the failed pods are still observable,
-            # so the re-enqueued sync redoes the accounting. Deleting first
-            # would lose the increment on conflict (restart without trace).
-            if not self._write_status(job):
-                return True
-            self.recorder.event(
-                "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
+            existing = helpers.get_condition(
+                job.status, JobConditionType.RESTARTING
             )
-            self.metrics.inc("tpujob.gang_restarts")
+            already_counted = (
+                existing is not None
+                and existing.status
+                and existing.message
+                == self._gang_restart_message(job.status.gang_restarts, failed_ids)
+            )
+            if not already_counted:
+                job.status.gang_restarts += 1
+                helpers.set_condition(
+                    job.status, JobConditionType.RESTARTING,
+                    reason="GangRestart",
+                    message=self._gang_restart_message(
+                        job.status.gang_restarts, failed_ids
+                    ),
+                )
+                # Persist the restart count BEFORE deleting pods: if this
+                # write conflicts, stop here — the failed pods are still
+                # observable, so the re-enqueued sync redoes the accounting.
+                # Deleting first would lose the increment on conflict
+                # (restart without trace).
+                if not self._write_status(job):
+                    return True
+                self.recorder.event(
+                    "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
+                )
+                self.metrics.inc("tpujob.gang_restarts")
             self._delete_job_pods(job, only_phases=None)
             return True
 
