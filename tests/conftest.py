@@ -5,20 +5,16 @@ its reconcile loop against a fake clientset instead of a cluster
 
 Note: the axon TPU environment imports jax from sitecustomize at
 interpreter startup, so JAX_PLATFORMS is already latched — the platform
-must be overridden via jax.config, and XLA_FLAGS set before first backend
-initialization (which has not happened yet at conftest time).
+must be overridden in-process before first backend initialization, which
+is what runtime.launcher.force_platform does (the single shared copy of
+the workaround).
 """
 
 import os
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tfk8s_tpu.runtime.launcher import force_platform  # noqa: E402
+
+assert force_platform("cpu", 8), "JAX backend already initialized before conftest"

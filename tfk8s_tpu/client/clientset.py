@@ -75,10 +75,11 @@ class TypedClient:
         return self._store.update(obj)
 
     def update_status(self, obj: Any) -> Any:
-        """Status-subresource-shaped write: same optimistic-concurrency rules
-        as update (separate verb so fakes/tests can distinguish intent)."""
+        """Status-subresource write: only ``obj.status`` is applied, under
+        the same optimistic-concurrency rules as update (over the wire
+        this is the ``PUT .../{name}/status`` route)."""
         self._limiter.accept()
-        return self._store.update(obj)
+        return self._store.update_status(obj)
 
     def delete(self, name: str) -> Any:
         self._limiter.accept()

@@ -1,0 +1,274 @@
+"""Remote cluster client: the ClusterStore interface over HTTP.
+
+The other half of the process boundary (client/apiserver.py): a
+:class:`RemoteStore` presents the exact CRUD+watch surface of the
+in-memory :class:`~tfk8s_tpu.client.store.ClusterStore`, but every call is
+a REST request to ``/apis/<group>/<version>/namespaces/*/<plural>/...``
+(the path shape of k8s-operator.md:33-34). A
+:class:`~tfk8s_tpu.client.clientset.Clientset` built over a RemoteStore is
+therefore a *real* remote client — the informers, controller, and kubelet
+run unchanged against it, which is the swap the reference performs with
+``clientcmd.BuildConfigFromFlags → NewForConfig``
+(k8s-operator.md:92-102, images/tf4-tf6).
+
+Kubeconfig: a small JSON file ``{"server": "http://host:port", "qps": ...,
+"burst": ...}`` — :func:`load_kubeconfig` + :func:`clientset_from_kubeconfig`
+mirror the reference's kubeconfig-flag path (`k8s-operator.md:206-207`).
+
+Watch streams: one long-lived HTTP response per watch, newline-delimited
+JSON events pumped into a :class:`~tfk8s_tpu.client.store.Watch` by a
+reader thread; ``stop()`` closes the socket, which the server notices via
+its heartbeat write. HTTP errors map back to the store's exception types
+(404 NotFound / 409 AlreadyExists|Conflict / 410 Gone), so reflector
+relist-on-Gone works identically across the wire.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from tfk8s_tpu import API_VERSION
+from tfk8s_tpu.api import serde
+from tfk8s_tpu.client.apiserver import KIND_TO_PLURAL
+from tfk8s_tpu.client.clientset import Clientset, RESTConfig
+from tfk8s_tpu.client.store import (
+    AlreadyExists,
+    Conflict,
+    EventType,
+    Gone,
+    NotFound,
+    StoreError,
+    Watch,
+    WatchEvent,
+)
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("remote")
+
+_TIMEOUT_S = 30.0
+# Watch-stream read deadline: several server heartbeat intervals (the
+# server writes a HEARTBEAT line every 2s when idle). A silent peer death
+# — power loss, network partition with no FIN/RST — surfaces as
+# socket.timeout in the pump, which ends the watch; the reflector then
+# relists, exactly the liveness contract the heartbeats exist for.
+_WATCH_READ_TIMEOUT_S = 10.0
+
+
+def _map_error(status: int, reason: str, message: str) -> StoreError:
+    if status == 404:
+        return NotFound(message)
+    if status == 409 and reason == "AlreadyExists":
+        return AlreadyExists(message)
+    if status == 409:
+        return Conflict(message)
+    if status == 410:
+        return Gone(message)
+    return StoreError(f"HTTP {status} {reason}: {message}")
+
+
+class RemoteWatch(Watch):
+    """Watch fed by a reader thread draining one HTTP watch response."""
+
+    def __init__(self, resp) -> None:
+        super().__init__()
+        self._resp = resp
+        self._thread = threading.Thread(
+            target=self._pump, daemon=True, name="remote-watch"
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            for raw in self._resp:
+                if self._stopped:
+                    break
+                line = raw.strip()
+                if not line:
+                    continue
+                data = json.loads(line)
+                if data.get("type") == "HEARTBEAT":
+                    continue
+                self._push(
+                    WatchEvent(
+                        EventType(data["type"]), serde.decode_object(data["object"])
+                    )
+                )
+        except (OSError, ValueError):
+            pass  # connection torn down (stop() or server shutdown)
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        super().stop()
+        try:
+            self._resp.close()
+        except OSError:
+            pass
+
+
+class RemoteStore:
+    """ClusterStore-shaped facade over the HTTP apiserver."""
+
+    def __init__(self, base_url: str, timeout: float = _TIMEOUT_S):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _path(self, kind: str, namespace: Optional[str], name: Optional[str] = None) -> str:
+        plural = KIND_TO_PLURAL[kind]
+        if namespace is None:
+            p = f"/apis/{API_VERSION}/{plural}"
+        else:
+            p = f"/apis/{API_VERSION}/namespaces/{namespace}/{plural}"
+        if name is not None:
+            p += f"/{urllib.parse.quote(name)}"
+        return p
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        query: Optional[Dict[str, str]] = None,
+        stream: bool = False,
+    ):
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=_WATCH_READ_TIMEOUT_S if stream else self.timeout
+            )
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except ValueError:
+                pass
+            raise _map_error(
+                e.code, payload.get("reason", ""), payload.get("message", str(e))
+            ) from None
+        except urllib.error.URLError as e:
+            raise StoreError(f"apiserver unreachable at {url}: {e.reason}") from None
+        if stream:
+            return resp
+        return json.loads(resp.read() or b"{}")
+
+    # -- the ClusterStore surface ------------------------------------------
+
+    def create(self, obj: Any) -> Any:
+        data = self._request(
+            "POST",
+            self._path(obj.kind, obj.metadata.namespace or "default"),
+            body=serde.to_dict(obj),
+        )
+        return serde.decode_object(data)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        data = self._request("GET", self._path(kind, namespace, name))
+        return serde.decode_object(data)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Any], int]:
+        query: Dict[str, str] = {}
+        if label_selector:
+            query["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(label_selector.items())
+            )
+        data = self._request("GET", self._path(kind, namespace), query=query or None)
+        items = [serde.decode_object(d) for d in data.get("items", [])]
+        return items, int(data.get("resourceVersion", 0))
+
+    def update(self, obj: Any) -> Any:
+        data = self._request(
+            "PUT",
+            self._path(obj.kind, obj.metadata.namespace or "default", obj.metadata.name),
+            body=serde.to_dict(obj),
+        )
+        return serde.decode_object(data)
+
+    def update_status(self, obj: Any) -> Any:
+        data = self._request(
+            "PUT",
+            self._path(obj.kind, obj.metadata.namespace or "default", obj.metadata.name)
+            + "/status",
+            body=serde.to_dict(obj),
+        )
+        return serde.decode_object(data)
+
+    def delete(self, kind: str, namespace: str, name: str) -> Any:
+        data = self._request("DELETE", self._path(kind, namespace, name))
+        return serde.decode_object(data)
+
+    def watch(self, kind: str, since_rv: Optional[int] = None) -> Watch:
+        query = {"watch": "1"}
+        if since_rv is not None:
+            query["resourceVersion"] = str(since_rv)
+        resp = self._request(
+            "GET", self._path(kind, None), query=query, stream=True
+        )
+        return RemoteWatch(resp)
+
+    def stop_watch(self, w: Watch) -> None:
+        w.stop()
+
+    def healthz(self) -> bool:
+        try:
+            data = self._request("GET", "/healthz")
+            return data.get("status") == "ok"
+        except StoreError:
+            return False
+
+
+@dataclass
+class Kubeconfig:
+    """Minimal kubeconfig: where the apiserver lives + client limits."""
+
+    server: str
+    qps: float = 50.0
+    burst: int = 100
+    user_agent: str = "tfk8s-tpu-operator"
+
+
+def load_kubeconfig(path: str) -> Kubeconfig:
+    with open(path) as f:
+        data = json.load(f)
+    return Kubeconfig(
+        server=data["server"],
+        qps=float(data.get("qps", 50.0)),
+        burst=int(data.get("burst", 100)),
+        user_agent=data.get("user_agent", "tfk8s-tpu-operator"),
+    )
+
+
+def clientset_from_kubeconfig(path_or_cfg) -> Clientset:
+    """``BuildConfigFromFlags → NewForConfig`` in one step
+    (k8s-operator.md:92-102): kubeconfig → RemoteStore → rate-limited
+    Clientset."""
+    cfg = (
+        path_or_cfg
+        if isinstance(path_or_cfg, Kubeconfig)
+        else load_kubeconfig(path_or_cfg)
+    )
+    store = RemoteStore(cfg.server)
+    return Clientset.new_for_config(
+        store,
+        RESTConfig(qps=cfg.qps, burst=cfg.burst, user_agent=cfg.user_agent),
+    )

@@ -219,6 +219,32 @@ class ClusterStore:
             self._emit(EventType.MODIFIED, stored)
             return copy.deepcopy(stored)
 
+    def update_status(self, obj: Any) -> Any:
+        """Status-subresource write: applies ONLY ``obj.status`` (same
+        optimistic-concurrency rules as update). Spec and metadata edits
+        riding along are discarded — the real apiserver's subresource
+        isolation, so a status writer can never clobber a concurrent spec
+        change it hasn't seen."""
+        with self._lock:
+            bucket = self._bucket(obj.kind)
+            k = obj.metadata.key
+            if k not in bucket:
+                raise NotFound(f"{obj.kind} {k} not found")
+            current = bucket[k]
+            if obj.metadata.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.kind} {k}: resource_version "
+                    f"{obj.metadata.resource_version} != {current.metadata.resource_version}"
+                )
+            if not hasattr(current, "status"):
+                raise StoreError(f"{obj.kind} has no status subresource")
+            stored = copy.deepcopy(current)
+            stored.status = copy.deepcopy(obj.status)
+            stored.metadata.resource_version = self._bump()
+            bucket[k] = stored
+            self._emit(EventType.MODIFIED, stored)
+            return copy.deepcopy(stored)
+
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         """Finalizer-aware delete (k8s-operator.md:36-43): with finalizers
         present only ``deletion_timestamp`` is set; otherwise remove."""

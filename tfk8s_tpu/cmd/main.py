@@ -3,11 +3,17 @@ Main → Option → flags → initlog → Run server, images/tf2.png).
 
 Subcommands:
 
-- ``operator``  run the reconcile server (the reference's only mode)
+- ``operator``  run the reconcile server (the reference's only mode);
+                with ``--kubeconfig`` it reconciles against a remote
+                apiserver across a process boundary
 - ``run``       end-to-end local demo: operator + kubelet in-process,
                 submit one TPUJob, wait for a terminal condition
 - ``train``     run a model entrypoint directly in this process (the
                 data-plane launcher, no control plane — for debugging)
+- ``apiserver`` serve the cluster store over HTTP (client/apiserver.py)
+                — the L0 substrate as its own process
+- ``kubelet``   run the pod executor as its own process against a remote
+                apiserver (the node-agent half of the process split)
 """
 
 from __future__ import annotations
@@ -50,6 +56,18 @@ def _build_parser() -> argparse.ArgumentParser:
     p_tr = sub.add_parser("train", help="run a model entrypoint in-process")
     p_tr.add_argument("--entrypoint", required=True)
     p_tr.add_argument("--env", default="{}")
+
+    p_api = sub.add_parser("apiserver", help="serve the cluster store over HTTP")
+    p_api.add_argument("--host", default="127.0.0.1")
+    p_api.add_argument("--port", type=int, default=8443)
+    p_api.add_argument("--write-kubeconfig", default="", dest="write_kubeconfig",
+                       help="write a kubeconfig JSON for the bound address "
+                       "(use with --port 0 to discover the ephemeral port)")
+
+    p_kl = sub.add_parser("kubelet", help="run the pod executor against a remote apiserver")
+    p_kl.add_argument("--kubeconfig", required=True)
+    p_kl.add_argument("--name", default="kubelet-0",
+                      help="node name recorded in pod status")
     return parser
 
 
@@ -155,10 +173,61 @@ def load_manifest(path: str):
 
 
 def _cmd_train(args: argparse.Namespace) -> int:
+    _maybe_force_platform()
     from tfk8s_tpu.runtime import registry
 
     fn = registry.resolve(args.entrypoint)
     registry.call(fn, json.loads(args.env or "{}"), threading.Event())
+    return 0
+
+
+def _cmd_apiserver(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.client.apiserver import APIServer
+    from tfk8s_tpu.client.store import ClusterStore
+
+    server = APIServer(ClusterStore(), host=args.host, port=args.port)
+    if args.write_kubeconfig:
+        with open(args.write_kubeconfig, "w") as f:
+            json.dump({"server": server.url}, f)
+    log.info("apiserver listening on %s", server.url)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down")
+    finally:
+        server.shutdown()
+    return 0
+
+
+def _maybe_force_platform() -> None:
+    """Honor ``TFK8S_JAX_PLATFORM`` before first backend use (subprocess-
+    spawned data-plane processes can't rely on env vars alone — see
+    runtime.launcher.force_platform)."""
+    import os
+
+    plat = os.environ.get("TFK8S_JAX_PLATFORM", "")
+    if plat:
+        from tfk8s_tpu.runtime.launcher import force_platform
+
+        force_platform(plat)
+
+
+def _cmd_kubelet(args: argparse.Namespace) -> int:
+    _maybe_force_platform()
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+    from tfk8s_tpu.runtime.kubelet import LocalKubelet
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    kubelet = LocalKubelet(cs, name=args.name)
+    stop = threading.Event()
+    log.info("kubelet %s watching pods via %s", args.name, args.kubeconfig)
+    try:
+        kubelet.run(stop)
+        stop.wait()
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down")
+    finally:
+        stop.set()
     return 0
 
 
@@ -167,6 +236,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "train":
         init_logging()
         return _cmd_train(args)
+    if args.command == "apiserver":
+        init_logging()
+        return _cmd_apiserver(args)
+    if args.command == "kubelet":
+        init_logging()
+        return _cmd_kubelet(args)
     opts = Options.from_args(args)
     init_logging(opts.log_level_int())
     if args.command == "operator":

@@ -37,6 +37,11 @@ class Options:
     capacity: Dict[str, int] = dataclasses.field(default_factory=dict)
     # run the in-process kubelet (hermetic/local backend)
     local_kubelet: bool = True
+    # path to a kubeconfig JSON ({"server": "http://host:port", ...});
+    # when set, the operator talks to that remote apiserver instead of an
+    # in-process store (the reference's kubeconfig flag,
+    # k8s-operator.md:206-207)
+    kubeconfig: str = ""
     # observability endpoint (/metrics, /healthz, /events); 0 = disabled
     metrics_port: int = 0
     # logging
@@ -75,6 +80,9 @@ class Options:
         g.add_argument("--no-local-kubelet", action="store_false",
                        dest="local_kubelet",
                        help="do not run the in-process pod executor")
+        g.add_argument("--kubeconfig", default="",
+                       help="kubeconfig JSON path; talk to a remote "
+                       "apiserver instead of the in-process store")
         g.add_argument("--metrics-port", type=int, default=0, dest="metrics_port",
                        help="serve /metrics, /healthz, /events on this port (0=off)")
         g.add_argument("--log-level", default="info",
@@ -97,6 +105,7 @@ class Options:
             identity=args.identity,
             capacity=capacity,
             local_kubelet=args.local_kubelet,
+            kubeconfig=getattr(args, "kubeconfig", ""),
             metrics_port=args.metrics_port,
             log_level=args.log_level,
         )

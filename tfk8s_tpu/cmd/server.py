@@ -33,9 +33,23 @@ class Server:
 
     def __init__(self, opts: Options, store: Optional[ClusterStore] = None):
         self.opts = opts
-        self.store = store if store is not None else ClusterStore()
+        qps, burst = opts.qps, opts.burst
+        if store is not None:
+            self.store = store
+        elif opts.kubeconfig:
+            # remote backend: kubeconfig → RemoteStore, the reference's
+            # BuildConfigFromFlags → NewForConfig path
+            # (k8s-operator.md:92-102). The kubeconfig's client limits
+            # take precedence — they describe the server being talked to.
+            from tfk8s_tpu.client.remote import RemoteStore, load_kubeconfig
+
+            cfg = load_kubeconfig(opts.kubeconfig)
+            self.store = RemoteStore(cfg.server)
+            qps, burst = cfg.qps, cfg.burst
+        else:
+            self.store = ClusterStore()
         self.clientset = Clientset.new_for_config(
-            self.store, RESTConfig(qps=opts.qps, burst=opts.burst)
+            self.store, RESTConfig(qps=qps, burst=burst)
         )
         self.allocator = SliceAllocator(opts.capacity or None)
         self.recorder = EventRecorder()

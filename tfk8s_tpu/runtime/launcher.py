@@ -77,6 +77,29 @@ class ProcessContext:
         return self.gang_restarts > 0
 
 
+def force_platform(platform: str, num_devices: Optional[int] = None) -> bool:
+    """Best-effort JAX platform switch before first backend use — THE one
+    copy of the platform-latch workaround (sitecustomize imports jax at
+    interpreter startup, so env vars alone don't switch platforms; the
+    config must be updated in-process before any device query). With
+    ``num_devices`` on cpu, provisions that many virtual host devices.
+    Returns False when the backend was already initialized (config
+    latched) — callers decide whether the devices that exist suffice."""
+    if num_devices and platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={num_devices}"
+            ).strip()
+    try:
+        jax.config.update("jax_platforms", platform)
+        if num_devices is not None and platform == "cpu":
+            jax.config.update("jax_num_cpu_devices", int(num_devices))
+        return True
+    except Exception:  # backend already initialized
+        return False
+
+
 def initialize_distributed(ctx: ProcessContext, env: Optional[Dict[str, str]] = None) -> None:
     """Real multi-host path: one JAX process per TPU VM host. Gated on
     ``TFK8S_DISTRIBUTED=1`` so hermetic in-process runs (threads sharing one
