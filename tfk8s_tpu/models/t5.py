@@ -35,6 +35,7 @@ from tfk8s_tpu.models.transformer import (
     EncoderLayer,
     TransformerConfig,
     _ln,
+    apply_with_aux,
     maybe_remat,
 )
 from tfk8s_tpu.runtime.train import TrainTask, run_task
@@ -54,7 +55,10 @@ class T5(nn.Module):
         self.embed = Embedder(cfg, name="embed")
         enc_layer = maybe_remat(EncoderLayer, cfg)
         dec_layer = maybe_remat(DecoderLayer, cfg)
-        self.enc_layers = [enc_layer(cfg, name=f"enc{i}") for i in range(cfg.num_layers)]
+        self.enc_layers = [
+            enc_layer(cfg, use_moe=cfg.layer_uses_moe(i), name=f"enc{i}")
+            for i in range(cfg.num_layers)
+        ]
         self.dec_layers = [dec_layer(cfg, name=f"dec{i}") for i in range(cfg.num_layers)]
         self.enc_ln = _ln("enc_ln")
         self.dec_ln = _ln("dec_ln")
@@ -128,7 +132,9 @@ def make_task(
         return model.init(rng, z, z)["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        logits = model.apply({"params": params}, batch["src"], batch["tgt_in"])
+        logits, aux = apply_with_aux(
+            model, cfg, params, batch["src"], batch["tgt_in"]
+        )
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["tgt_out"]
         )
@@ -138,7 +144,11 @@ def make_task(
         acc = jnp.sum(
             (jnp.argmax(logits, -1) == batch["tgt_out"]).astype(jnp.float32) * w
         ) / denom
-        return loss, {"token_accuracy": acc}
+        metrics = {"token_accuracy": acc}
+        if cfg.num_experts > 0:
+            metrics["moe_aux"] = aux
+            loss = loss + cfg.moe_aux_weight * aux
+        return loss, metrics
 
     return TrainTask(
         name="t5-seq2seq",
@@ -151,10 +161,15 @@ def make_task(
 
 
 def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
-    """TPUJob entrypoint: ``tfk8s_tpu.models.t5:train``."""
+    """TPUJob entrypoint: ``tfk8s_tpu.models.t5:train``. MoE (EP) in the
+    encoder is job-configurable via ``TFK8S_NUM_EXPERTS``."""
     env = dict(env)
     env.setdefault("TFK8S_TRAIN_STEPS", "100")
     env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
     seq = int(env.get("TFK8S_SEQ_LEN", "128"))
     batch = int(env.get("TFK8S_BATCH_SIZE", "32"))
-    run_task(make_task(seq_len=seq, batch_size=batch), env, stop)
+    cfg = base_config(
+        num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
+        moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
+    )
+    run_task(make_task(cfg=cfg, seq_len=seq, batch_size=batch), env, stop)

@@ -49,15 +49,41 @@ class TransformerConfig:
     # 'full' | 'ring' — ring requires a mesh with a sequence axis and is
     # injected by the task wrapper (models/bert.py etc.)
     attention_impl: str = "full"
+    # Mixture-of-Experts (EP row, SURVEY.md §2): 0 = dense MLP everywhere;
+    # >0 swaps the MLP of every ``moe_every``-th layer for a
+    # SwitchMoeBlock with this many experts (parallel/moe.py), whose aux
+    # loss is sown into the "losses" collection and added to the
+    # objective with weight ``moe_aux_weight`` by the task wrappers.
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    # False drops the flax Partitioned boxes from layer params. Needed
+    # inside manual-collective regions (shard_map pipeline stages): flax
+    # re-runs initializers under eval_shape at apply time, and a boxed
+    # init would emit a sharding constraint naming logical axes the
+    # manual mesh doesn't have (models/pipelined.py shards stage params
+    # over ``pipeline`` via the stage-stacking rebox instead).
+    partition_params: bool = True
+
+    def layer_uses_moe(self, layer_idx: int) -> bool:
+        """MoE layers interleave dense ones (every ``moe_every``-th layer,
+        counting from the top of each group — the Switch/GShard layout)."""
+        return (
+            self.num_experts > 0
+            and layer_idx % self.moe_every == self.moe_every - 1
+        )
 
 
-def _dense(features, names, name, dtype, axis=-1):
+def _dense(features, names, name, dtype, axis=-1, partition=True):
+    init = nn.initializers.xavier_uniform()
     return nn.DenseGeneral(
         features=features,
         axis=axis,
         dtype=dtype,
         param_dtype=jnp.float32,
-        kernel_init=nn.with_partitioning(nn.initializers.xavier_uniform(), names),
+        kernel_init=nn.with_partitioning(init, names) if partition else init,
         bias_init=nn.initializers.zeros,
         name=name,
     )
@@ -80,9 +106,10 @@ class MultiHeadAttention(nn.Module):
     ) -> jax.Array:
         cfg = self.cfg
         kv = x if kv is None else kv
-        q = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q", cfg.dtype)(x)
-        k = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "k", cfg.dtype)(kv)
-        v = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "v", cfg.dtype)(kv)
+        part = cfg.partition_params
+        q = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "q", cfg.dtype, partition=part)(x)
+        k = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "k", cfg.dtype, partition=part)(kv)
+        v = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "v", cfg.dtype, partition=part)(kv)
         q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
 
         if self.attn_fn is not None:
@@ -91,7 +118,8 @@ class MultiHeadAttention(nn.Module):
             out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
 
         return _dense(
-            cfg.embed_dim, ("heads", "kv", "embed"), "out", cfg.dtype, axis=(-2, -1)
+            cfg.embed_dim, ("heads", "kv", "embed"), "out", cfg.dtype, axis=(-2, -1),
+            partition=cfg.partition_params,
         )(out)
 
 
@@ -121,9 +149,11 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
-        h = _dense(cfg.mlp_dim, ("embed", "mlp"), "wi", cfg.dtype)(x)
+        h = _dense(cfg.mlp_dim, ("embed", "mlp"), "wi", cfg.dtype,
+                   partition=cfg.partition_params)(x)
         h = nn.gelu(h)
-        return _dense(cfg.embed_dim, ("mlp", "embed"), "wo", cfg.dtype)(h)
+        return _dense(cfg.embed_dim, ("mlp", "embed"), "wo", cfg.dtype,
+                      partition=cfg.partition_params)(h)
 
 
 def _ln(name: str) -> nn.LayerNorm:
@@ -133,10 +163,15 @@ def _ln(name: str) -> nn.LayerNorm:
 
 
 class EncoderLayer(nn.Module):
-    """Pre-LN residual block (more stable than post-LN, standard on TPU)."""
+    """Pre-LN residual block (more stable than post-LN, standard on TPU).
+
+    With ``use_moe`` the MLP is a SwitchMoeBlock; its load-balance aux
+    loss is sown into the ``"losses"`` collection (task wrappers apply
+    with ``mutable=["losses"]`` and fold it into the objective)."""
 
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
@@ -144,6 +179,18 @@ class EncoderLayer(nn.Module):
         h = _ln("ln_attn")(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(cfg, attn_fn=self.attn_fn, name="attn")(h, mask=mask)
         h = _ln("ln_mlp")(x).astype(cfg.dtype)
+        if self.use_moe:
+            from tfk8s_tpu.parallel.moe import SwitchMoeBlock
+
+            y, aux = SwitchMoeBlock(
+                cfg,
+                num_experts=cfg.num_experts,
+                capacity_factor=cfg.moe_capacity_factor,
+                top_k=cfg.moe_top_k,
+                name="moe",
+            )(h)
+            self.sow("losses", "moe_aux", aux)
+            return x + y
         return x + MlpBlock(cfg, name="mlp")(h)
 
 
@@ -203,6 +250,16 @@ class Embedder(nn.Module):
         )
 
 
+def apply_with_aux(model, cfg: TransformerConfig, params, *inputs):
+    """Apply ``model`` collecting sown MoE aux losses -> (out, aux).
+    Dense configs skip the mutable plumbing entirely (aux = 0)."""
+    if cfg.num_experts > 0:
+        out, mods = model.apply({"params": params}, *inputs, mutable=["losses"])
+        aux = sum(jax.tree_util.tree_leaves(mods.get("losses", {})), 0.0)
+        return out, aux
+    return model.apply({"params": params}, *inputs), 0.0
+
+
 def maybe_remat(layer_cls, cfg: TransformerConfig):
     """jax.checkpoint each layer when cfg.remat — recompute activations in
     the backward pass instead of holding them in HBM."""
@@ -221,5 +278,10 @@ class Encoder(nn.Module):
         x = Embedder(cfg, name="embed")(ids)
         layer = maybe_remat(EncoderLayer, cfg)
         for i in range(cfg.num_layers):
-            x = layer(cfg, attn_fn=self.attn_fn, name=f"layer{i}")(x, mask)
+            x = layer(
+                cfg,
+                attn_fn=self.attn_fn,
+                use_moe=cfg.layer_uses_moe(i),
+                name=f"layer{i}",
+            )(x, mask)
         return _ln("ln_final")(x).astype(cfg.dtype)

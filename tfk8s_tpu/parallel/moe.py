@@ -9,10 +9,17 @@ GSPMD lowers the dispatch/combine contractions to ``all_to_all`` over
 the expert ICI axis — no hand-written collectives (SURVEY.md §2
 'Distributed communication backend').
 
-Top-1 (switch) routing with a capacity factor; overflowing tokens fall
-through the residual connection (standard dropless-approximation
-behavior). The load-balancing auxiliary loss is the Switch Transformer
-one: E * sum_e(importance_e * load_e).
+Routing is top-1 (Switch) or top-2 (GShard) per ``top_k``; each choice
+is capacity-bucketed (top-2's second choice queues behind every first
+choice, the GShard ordering) and overflowing tokens fall through the
+residual connection (standard dropless-approximation behavior). The
+load-balancing auxiliary loss is the Switch Transformer one:
+E * sum_e(importance_e * load_e), with load counted over first choices.
+
+Wired into the model families through ``TransformerConfig.num_experts``
+(models/transformer.py EncoderLayer swaps its MlpBlock for this block and
+sows the aux loss), so BERT/T5 tasks and TPUJob configs reach EP without
+bespoke plumbing.
 """
 
 from __future__ import annotations
@@ -36,14 +43,17 @@ class SwitchMoeBlock(nn.Module):
     cfg: TransformerConfig
     num_experts: int = 8
     capacity_factor: float = 1.25
+    top_k: int = 1  # 1 = Switch, 2 = GShard
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         cfg = self.cfg
+        assert self.top_k in (1, 2), f"top_k must be 1 or 2, got {self.top_k}"
         g, s, m = x.shape  # [batch, seq, embed]
         e = self.num_experts
         h = cfg.mlp_dim
-        c = max(int(self.capacity_factor * s / e), 1)  # per-expert per-batch slots
+        # per-expert per-batch slots; top-2 doubles the routed token count
+        c = max(int(self.capacity_factor * self.top_k * s / e), 1)
 
         router = self.param(
             "router",
@@ -73,28 +83,61 @@ class SwitchMoeBlock(nn.Module):
         # --- routing (fp32 for a stable softmax) -------------------------
         logits = jnp.einsum("gsm,me->gse", x.astype(jnp.float32), router)
         probs = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.max(probs, axis=-1)  # [g, s]
-        expert_idx = jnp.argmax(probs, axis=-1)  # [g, s]
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [g,s,e]
-
-        # capacity bucketing: position of each token in its expert's queue
-        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0  # [g,s,e]; -1 if unrouted
-        pos_sel = jnp.sum(pos * onehot, axis=-1)  # [g,s] queue slot of the token
-        # one_hot is all-zero for slots >= c, so overflow drops out here
-        disp = jax.nn.one_hot(pos_sel.astype(jnp.int32), c, dtype=jnp.float32)
-        dispatch = onehot[..., None] * disp[:, :, None, :]  # [g,s,e,c]
+        dispatch = compute_dispatch(probs, self.top_k, c)
 
         # --- dispatch -> expert FFN -> combine ---------------------------
-        xe = jnp.einsum("gsec,gsm->gecm", dispatch, x.astype(jnp.float32))
+        # dispatch carries the gate weights; route with the binarized mask
+        route = (dispatch > 0).astype(jnp.float32)  # [g,s,e,c]
+        xe = jnp.einsum("gsec,gsm->gecm", route, x.astype(jnp.float32))
         hmid = jnp.einsum("gecm,emh->gech", xe.astype(cfg.dtype), wi.astype(cfg.dtype))
         hmid = nn.gelu(hmid)
         ye = jnp.einsum("gech,ehm->gecm", hmid, wo.astype(cfg.dtype))
-        combine = dispatch * gate[:, :, None, None]  # gate-weighted
-        y = jnp.einsum("gsec,gecm->gsm", combine, ye.astype(jnp.float32))
+        y = jnp.einsum("gsec,gecm->gsm", dispatch, ye.astype(jnp.float32))
 
         # --- switch load-balance aux loss --------------------------------
+        onehot1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e, dtype=jnp.float32)
         importance = jnp.mean(probs, axis=(0, 1))  # mean router prob per expert
-        load = jnp.mean(onehot, axis=(0, 1))  # fraction routed per expert
+        load = jnp.mean(onehot1, axis=(0, 1))  # fraction routed per expert
         aux = e * jnp.sum(importance * load)
 
         return y.astype(cfg.dtype), aux
+
+
+def compute_dispatch(probs: jax.Array, top_k: int, capacity: int) -> jax.Array:
+    """[g,s,e] router probs -> gate-weighted [g,s,e,c] dispatch tensor.
+
+    Pure routing math (factored out of the block so the capacity/slot
+    invariants are directly testable): top-1 keeps the raw argmax gate;
+    top-2 normalizes the chosen pair's gates to sum to 1 and queues
+    second choices behind ALL first choices (the GShard ordering), so an
+    overloaded expert sheds second choices first. Tokens whose queue slot
+    lands beyond ``capacity`` fall out entirely (their one_hot is zero —
+    the dropless-approximation residual path)."""
+    e = probs.shape[-1]
+    gate1 = jnp.max(probs, axis=-1)  # [g, s]
+    onehot1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e, dtype=jnp.float32)
+
+    if top_k == 2:
+        probs2 = probs * (1.0 - onehot1)  # mask the first choice out
+        gate2 = jnp.max(probs2, axis=-1)
+        onehot2 = jax.nn.one_hot(jnp.argmax(probs2, axis=-1), e, dtype=jnp.float32)
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        gate1n, gate2n = gate1 / denom, gate2 / denom
+    else:
+        gate1n = gate1
+
+    pos1 = jnp.cumsum(onehot1, axis=1) * onehot1 - 1.0  # [g,s,e]
+    dispatch = _dispatch_mask(onehot1, pos1, capacity) * gate1n[:, :, None, None]
+    if top_k == 2:
+        load1 = jnp.sum(onehot1, axis=1, keepdims=True)  # [g,1,e]
+        pos2 = (jnp.cumsum(onehot2, axis=1) + load1) * onehot2 - 1.0
+        dispatch = dispatch + _dispatch_mask(onehot2, pos2, capacity) * gate2n[:, :, None, None]
+    return dispatch
+
+
+def _dispatch_mask(onehot: jax.Array, pos: jax.Array, capacity: int) -> jax.Array:
+    """[g,s,e] one-hot + queue positions -> [g,s,e,c] dispatch mask; slots
+    beyond capacity fall out (one_hot of an out-of-range index is zero)."""
+    pos_sel = jnp.sum(pos * onehot, axis=-1)  # [g,s] slot of the token
+    slot = jax.nn.one_hot(pos_sel.astype(jnp.int32), capacity, dtype=jnp.float32)
+    return onehot[..., None] * slot[:, :, None, :]

@@ -46,10 +46,16 @@ def pipeline_apply(
     microbatches: jax.Array,  # [num_micro, mb, ...]
     mesh: Mesh,
     axis: str = AXIS_PIPELINE,
+    data_axis: str | None = None,
 ) -> jax.Array:
     """Run ``y_i = stageS(...stage1(stage0(x_i)))`` for every microbatch
     with stages executing in pipeline. ``stage_fn(stage_params, x) -> y``
-    must preserve x's shape (the inter-stage activation contract)."""
+    must preserve x's shape (the inter-stage activation contract — embed
+    and head live OUTSIDE the pipeline region, models/pipelined.py).
+
+    ``data_axis`` composes PP with DP: microbatches arrive sharded over
+    that mesh axis on their per-microbatch batch dim (dim 1) and each
+    data shard pipelines its own slice — the PP×DP grid."""
     num_stages = mesh.shape[axis]
     num_micro = microbatches.shape[0]
 
@@ -100,11 +106,12 @@ def pipeline_apply(
         outputs = jnp.where(stage == num_stages - 1, outputs, 0)
         return lax.psum(outputs, axis)
 
+    mb_spec = P(None, data_axis) if data_axis else P()
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), mb_spec),
+        out_specs=mb_spec,
         check_vma=False,
     )(stacked_params, microbatches)
 
