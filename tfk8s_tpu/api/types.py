@@ -171,6 +171,11 @@ class TPUSpec:
     accelerator: str = ""
     topology: str = ""
     num_slices: int = 1
+    # "" = hermetic/local rendering (tfk8s.dev/* node selectors only);
+    # "gke" additionally renders google.com/tpu resource requests and
+    # cloud.google.com/gke-tpu-* node selectors a real GKE TPU nodepool
+    # admits (the north star's GKE provisioning, BASELINE.json)
+    provider: str = ""
 
 
 @dataclass

@@ -69,6 +69,18 @@ def validate(job: TPUJob) -> List[str]:
             errs.append(f"spec.tpu: {e}")
     if spec.tpu.num_slices < 1:
         errs.append(f"spec.tpu.numSlices: must be >= 1, got {spec.tpu.num_slices}")
+    if spec.tpu.provider not in ("", "gke"):
+        errs.append(
+            f"spec.tpu.provider: must be '' (hermetic) or 'gke', "
+            f"got {spec.tpu.provider!r}"
+        )
+    elif spec.tpu.provider == "gke" and info is not None:
+        if info.generation not in topo.GKE_ACCELERATOR:
+            errs.append(
+                f"spec.tpu.provider: 'gke' has no nodepool shape for "
+                f"generation {info.generation!r} "
+                f"(supported: {sorted(set(topo.GKE_ACCELERATOR))})"
+            )
 
     # Gang consistency: the compute replicas are the slice's hosts. One JAX
     # process per host (SURVEY.md §3.3 'pod scheduled onto TPU VM; JAX

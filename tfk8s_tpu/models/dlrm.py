@@ -183,9 +183,23 @@ def make_task(
 
 
 def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
-    """TPUJob entrypoint: ``tfk8s_tpu.models.dlrm:train``."""
+    """TPUJob entrypoint: ``tfk8s_tpu.models.dlrm:train``. The job's mesh
+    ``tensor`` axis is the embedding-shard axis — the PS replica set's
+    honest TPU translation (tables sharded by annotation, no PS
+    processes; SURVEY.md §7 hard part 3)."""
     env = dict(env)
     env.setdefault("TFK8S_TRAIN_STEPS", "100")
     env.setdefault("TFK8S_LEARNING_RATE", "1e-3")
     batch = int(env.get("TFK8S_BATCH_SIZE", "4096"))
-    run_task(make_task(batch_size=batch), env, stop)
+    vocab_raw = env.get("TFK8S_VOCAB_SIZES", "")
+    vocab = (
+        tuple(int(v) for v in vocab_raw.split(","))
+        if vocab_raw
+        else (100_000,) * 8
+    )
+    task = make_task(
+        vocab_sizes=vocab,
+        embed_dim=int(env.get("TFK8S_EMBED_DIM", "64")),
+        batch_size=batch,
+    )
+    run_task(task, env, stop)

@@ -12,6 +12,7 @@ JOB_NAME = "tfk8s.dev/job-name"
 REPLICA_TYPE = "tfk8s.dev/replica-type"
 REPLICA_INDEX = "tfk8s.dev/replica-index"
 SLICE_ID = "tfk8s.dev/slice-id"
+HOST_INDEX = "tfk8s.dev/host-index"
 CONTROLLER = "tfk8s.dev/controller"
 CONTROLLER_NAME = "tpujob-operator"
 

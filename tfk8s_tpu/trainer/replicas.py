@@ -42,6 +42,7 @@ from tfk8s_tpu.api.types import (
 )
 from tfk8s_tpu.trainer import labels as L
 from tfk8s_tpu.trainer.gang import GangAssignment
+from tfk8s_tpu.utils.topology import GKE_ACCELERATOR
 
 CHECKPOINT_DIR_ANNOTATION = "tfk8s.dev/checkpoint-dir"
 
@@ -87,16 +88,42 @@ def render_pod(
     pid = helpers.process_index(job, rtype, index)
     slice_id, host_index = assignment.host_of(pid)
     tmpl = rspec.template
+    resources = dict(tmpl.resources)
+    if job.spec.tpu.provider == "gke":
+        # GKE-shaped rendering (north star: replica specs provision TPU VM
+        # slices on GKE — the nvidia.com/gpu -> google.com/tpu swap). A
+        # real nodepool's nodes carry only the cloud.google.com/* labels,
+        # so those are the ONLY selectors (ANDed selectors naming
+        # tfk8s.dev/* would leave the pod Pending forever); the gang
+        # allocator's placement rides the pod labels instead. Topology
+        # info comes from the assignment's SliceHandle — parsed once at
+        # admission, not per rendered pod.
+        sl = assignment.slices[pid // assignment.hosts_per_slice]
+        info = sl.info
+        resources.setdefault("google.com/tpu", str(info.chips_per_host))
+        node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": GKE_ACCELERATOR[info.generation],
+            "cloud.google.com/gke-tpu-topology": "x".join(
+                str(d) for d in info.topology
+            ),
+        }
+    else:
+        node_selector = {
+            "tfk8s.dev/accelerator": job.spec.tpu.accelerator,
+            "tfk8s.dev/slice": slice_id,
+            "tfk8s.dev/host": str(host_index),
+        }
     container = ContainerSpec(
         entrypoint=tmpl.entrypoint,
         image=tmpl.image,
         command=list(tmpl.command),
         args=list(tmpl.args),
         env={**tmpl.env, **coordination_env(job, rtype, index, assignment)},
-        resources=dict(tmpl.resources),
+        resources=resources,
     )
     lbls = L.replica_labels(job.metadata.name, rtype, index)
     lbls[L.SLICE_ID] = slice_id
+    lbls[L.HOST_INDEX] = str(host_index)
     return Pod(
         metadata=ObjectMeta(
             name=name,
@@ -107,11 +134,7 @@ def render_pod(
         spec=PodSpec(
             containers=[container],
             restart_policy=rspec.restart_policy or RestartPolicy.ON_FAILURE,
-            node_selector={
-                "tfk8s.dev/accelerator": job.spec.tpu.accelerator,
-                "tfk8s.dev/slice": slice_id,
-                "tfk8s.dev/host": str(host_index),
-            },
+            node_selector=node_selector,
         ),
     )
 

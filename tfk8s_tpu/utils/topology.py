@@ -27,6 +27,18 @@ from typing import List, Tuple
 
 _GEN_RE = re.compile(r"^(v[0-9]+[a-z]*|cpu|v5litepod)(?:-([0-9]+))?$")
 
+# Cloud TPU generation -> GKE nodepool accelerator label value
+# (cloud.google.com/gke-tpu-accelerator on real TPU nodepools). Absent
+# generations (v2/v3/cpu) have no GKE TPU nodepool shape — validation
+# rejects provider="gke" for them rather than rendering a half-GKE pod.
+GKE_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v5litepod": "tpu-v5-lite-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v6e": "tpu-v6e-slice",
+}
+
 # generation -> (counts_cores, cores_per_chip, chips_per_host, ici_dims)
 _GENERATIONS = {
     "v2": (True, 2, 4, 2),
