@@ -77,6 +77,47 @@ def _time_task(task, mesh, steps: int, n_stage: int = 4) -> float:
     return (time.perf_counter() - t0) / steps
 
 
+def _flash_speedup(seq: int = 2048, iters: int = 8):
+    """Train-shaped attention (fwd+bwd, causal, bf16) at BERT-base head
+    geometry: Pallas flash kernels vs the XLA einsum path. Returns
+    (flash_ms, xla_ms) per fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfk8s_tpu.models.transformer import dot_product_attention
+    from tfk8s_tpu.ops.flash_attention import flash_attention
+
+    b, h, d = 8, 12, 64
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, seq, h, d)), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def time_one(attn) -> float:
+        grad = jax.grad(
+            lambda q, k, v: jnp.sum(
+                attn(q, k, v, causal=True).astype(jnp.float32) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )
+
+        def body(c, _):
+            dq, _dk, _dv = grad(c, k, v)
+            return c + 0.0 * dq.astype(c.dtype), ()  # chain the iterations
+
+        run = jax.jit(
+            lambda q: jax.lax.scan(body, q, None, length=iters)[0]
+        )
+        out = run(q)
+        float(np.asarray(out[0, 0, 0, 0]))  # compile + warm (host barrier)
+        t0 = time.perf_counter()
+        out = run(q)
+        float(np.asarray(out[0, 0, 0, 0]))
+        return (time.perf_counter() - t0) / iters * 1000
+
+    return time_one(flash_attention), time_one(dot_product_attention)
+
+
 def main() -> None:
     import jax
 
@@ -121,6 +162,13 @@ def main() -> None:
         bsteps = 20
     bert_sec = _time_task(bert_task, mesh, bsteps)
 
+    # -- flash-attention win at long sequence (VERDICT r2 item #6) ----------
+    flash_ms = xla_ms = None
+    if not small and os.environ.get("BENCH_FLASH", "1") == "1":
+        flash_ms, xla_ms = _flash_speedup(
+            seq=int(os.environ.get("BENCH_FLASH_SEQ", "2048"))
+        )
+
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     if os.path.exists(baseline_path):
@@ -144,6 +192,15 @@ def main() -> None:
                     "bert_seq_len": bert_seq,
                     "resnet_batch_size": rn_task.batch_size,
                     "n_chips": n_chips,
+                    **(
+                        {
+                            "flash_attn_ms_seq2048": round(flash_ms, 3),
+                            "xla_attn_ms_seq2048": round(xla_ms, 3),
+                            "flash_attn_speedup": round(xla_ms / flash_ms, 3),
+                        }
+                        if flash_ms
+                        else {}
+                    ),
                 },
             }
         )

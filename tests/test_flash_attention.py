@@ -58,6 +58,91 @@ def test_bf16_inputs():
     )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference_noncausal_and_causal(causal):
+    q, k, v = _qkv(l=64, d=8, seed=3)
+    g = jnp.asarray(
+        np.random.default_rng(9).standard_normal(q.shape), jnp.float32
+    )
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=16, block_k=16) * g
+        )
+
+    def f_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal) * g)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_bf16_gradients_within_1e2():
+    """bf16 grads agree with the f32 ground truth to ~1e-2 — and flash's
+    bf16 rounding error is no worse than the XLA attention's own bf16
+    error against the same truth (two equally-valid bf16 computation
+    orders differ by ULPs; the truth is the fp32 reference)."""
+    q, k, v = _qkv(l=128, d=16, dtype=jnp.bfloat16, seed=4)
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+            .astype(jnp.float32) ** 2
+        )
+
+    def f_ref(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, causal=True).astype(jnp.float32) ** 2
+        )
+
+    def max_rel(got, want):
+        g = np.asarray(got, np.float32)
+        w = np.asarray(want, np.float32)
+        return (np.abs(g - w) / np.maximum(np.abs(w), 1.0)).max()
+
+    truth = jax.grad(f_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gx, t in zip(g_flash, g_xla, truth):
+        err_flash = max_rel(gf, t)
+        err_xla = max_rel(gx, t)
+        assert err_flash < max(1e-2, 2.0 * err_xla), (err_flash, err_xla)
+
+
+def test_cross_attention_grads_lq_lt_lk():
+    """Bottom-right causal alignment must hold through the backward for
+    lq != lk (ADVICE r1 finding)."""
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((2, 32, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 64, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 64, 2, 8)), jnp.float32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=16, block_k=16) ** 2
+        )
+
+    def f_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(
+        float(f_flash(q, k, v)), float(f_ref(q, k, v)), rtol=1e-5
+    )
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_causal_lq_gt_lk_rejected():
+    q, k, v = _qkv(l=64)
+    with pytest.raises(ValueError):
+        flash_attention(q, k[:, :32], v[:, :32], causal=True)
+
+
 def test_mask_rejected():
     q, k, v = _qkv(l=32)
     with pytest.raises(NotImplementedError):

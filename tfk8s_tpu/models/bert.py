@@ -169,21 +169,27 @@ def task_for_mesh(
     """Build the task with the attention impl the mesh calls for: ring
     attention whenever the mesh has a nontrivial ``sequence`` axis (or
     cfg.attention_impl == 'ring'); the pallas flash kernel when
-    cfg.attention_impl == 'flash'."""
+    cfg.attention_impl == 'flash' — or by default on TPU once the
+    sequence length crosses FLASH_SEQ_THRESHOLD (the XLA path's [L, L]
+    scores buffer starts dominating HBM; flash's is O(L·d))."""
     from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
     from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
+    from tfk8s_tpu.ops import flash_attention as fa
 
     cfg = cfg or base_config()
     seq_sharded = (
         AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
     )
+    seq_len = task_kw.get("seq_len", 128)
     attn_fn = None
     if cfg.attention_impl == "ring" or seq_sharded:
         attn_fn = make_ring_attn_fn(mesh)
-    elif cfg.attention_impl == "flash":
-        from tfk8s_tpu.ops.flash_attention import flash_attention
-
-        attn_fn = flash_attention
+    elif cfg.attention_impl == "flash" or (
+        cfg.attention_impl == "full"
+        and fa._on_tpu()
+        and seq_len >= fa.FLASH_SEQ_THRESHOLD
+    ):
+        attn_fn = fa.flash_attention
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 

@@ -1,20 +1,25 @@
 """Gang scheduling: all-or-nothing admission of a job's replicas onto
-ICI-contiguous TPU slices.
+ICI-contiguous TPU sub-slices.
 
 The reference has no equivalent — k8s Jobs admit pods independently
 (k8s-operator.md:44-49) and a partially-scheduled TF cluster just wedges.
 On TPU the hardware forces the issue: a slice exists or it doesn't, and a
 job's mesh spans whole slices. This module is the SURVEY.md §7 hard-part-1
-answer: a slice inventory + atomic admission, so the controller either gets
-every host of every slice it needs or nothing, and slice loss releases the
-whole gang.
+answer, now topology-aware: the inventory is a set of physical slices
+whose host grids (utils/topology.py) are carved into axis-aligned BOXES
+of host blocks by guillotine splitting, so every admitted gang's hosts
+are ICI-contiguous *by construction* (property-tested in
+tests/test_topology_placement.py). A job asking for a smaller slice
+shape of the same generation (v5p-16 out of a v5p-32 inventory) gets a
+contiguous sub-grid rather than a whole fungible slice; releases return
+the boxes to the free list.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tfk8s_tpu.api.types import TPUJob
 from tfk8s_tpu.utils import topology as topo
@@ -24,18 +29,68 @@ log = get_logger("gang")
 
 
 @dataclasses.dataclass(frozen=True)
-class SliceHandle:
-    """One physical slice in the inventory."""
+class Box:
+    """Axis-aligned region of a physical slice's host grid."""
+
+    origin: Tuple[int, ...]
+    shape: Tuple[int, ...]
+
+    @property
+    def hosts(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def fits(self, shape: Tuple[int, ...]) -> bool:
+        return all(b >= r for b, r in zip(self.shape, shape))
+
+
+@dataclasses.dataclass(frozen=True)
+class PhysicalSlice:
+    """One slice in the cluster inventory."""
 
     slice_id: str
-    accelerator: str
     info: topo.SliceInfo
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceHandle:
+    """A job's allocated region: a contiguous box of hosts within one
+    physical slice (possibly the whole slice)."""
+
+    slice_id: str  # physical slice id
+    accelerator: str  # the REQUESTED accelerator type
+    info: topo.SliceInfo  # the requested slice shape
+    physical: Optional[PhysicalSlice] = None
+    box: Optional[Box] = None
+
+    def global_host_index(self, local_host: int) -> int:
+        """Job-local host index -> physical host index in the slice
+        (placement for node selectors). Identity when the handle is not
+        a carved sub-slice (cpu/hermetic)."""
+        if self.physical is None or self.box is None:
+            return local_host
+        local = topo.host_coords(
+            dataclasses.replace(self.info), local_host
+        ) if False else None
+        # local coords within the box, C-order over the box shape
+        coords = []
+        rem = local_host
+        for dim in reversed(self.box.shape):
+            coords.append(rem % dim)
+            rem //= dim
+        coords = tuple(reversed(coords))
+        phys_coords = tuple(o + c for o, c in zip(self.box.origin, coords))
+        return topo.host_index_of(self.physical.info, phys_coords)
 
 
 @dataclasses.dataclass
 class GangAssignment:
     """Result of admission: which slices a job got, and the host layout.
-    ``host_of(process_id)`` maps a job process to (slice_id, host_index)."""
+    ``host_of(process_id)`` maps a job process to (slice_id, job-local
+    host index); ``global_host_of`` gives the physical host index for
+    placement."""
 
     job_uid: str
     slices: List[SliceHandle]
@@ -45,33 +100,97 @@ class GangAssignment:
         s, h = divmod(process_id, self.hosts_per_slice)
         return self.slices[s].slice_id, h
 
+    def global_host_of(self, process_id: int) -> int:
+        s, h = divmod(process_id, self.hosts_per_slice)
+        return self.slices[s].global_host_index(h)
+
     @property
     def total_hosts(self) -> int:
         return len(self.slices) * self.hosts_per_slice
 
 
+def _guillotine_split(free: Box, want: Tuple[int, ...]) -> Tuple[Box, List[Box]]:
+    """Carve a ``want``-shaped box from ``free``'s origin corner;
+    remainder returned as new free boxes (one per dim that was cut)."""
+    assert free.fits(want)
+    remainders = []
+    cur = free
+    for d in range(len(want)):
+        if cur.shape[d] > want[d]:
+            # cut along d: keep [0, want_d), free the rest
+            rem_origin = tuple(
+                o + (want[d] if i == d else 0) for i, o in enumerate(cur.origin)
+            )
+            rem_shape = tuple(
+                (cur.shape[i] - want[d]) if i == d else (
+                    want[i] if i < d else cur.shape[i]
+                )
+                for i in range(len(want))
+            )
+            remainders.append(Box(rem_origin, rem_shape))
+    carved = Box(cur.origin, tuple(want))
+    return carved, remainders
+
+
 class SliceAllocator:
-    """Inventory of slices by accelerator type with atomic gang admission.
+    """Inventory of physical slices with atomic, topology-aware gang
+    admission.
 
     ``capacity`` maps accelerator type -> number of identical slices the
-    cluster owns (e.g. ``{"v5p-32": 4}``). ``cpu-*`` accelerators are
-    treated as unlimited local capacity (the hermetic backend)."""
+    cluster owns (e.g. ``{"v5p-32": 4}``). Jobs may request the same
+    type or a *smaller* slice shape of the same generation; either way
+    the allocation is a contiguous box of host blocks. ``cpu-*``
+    accelerators are treated as unlimited local capacity (the hermetic
+    backend)."""
 
     def __init__(self, capacity: Optional[Dict[str, int]] = None):
         self._lock = threading.Lock()
-        self._free: Dict[str, List[SliceHandle]] = {}
+        # physical slice id -> (PhysicalSlice, free boxes)
+        self._slices: Dict[str, Tuple[PhysicalSlice, List[Box]]] = {}
         self._assigned: Dict[str, GangAssignment] = {}
         self._cpu_counter = 0
         for acc, n in (capacity or {}).items():
             info = topo.parse_accelerator(acc)
-            self._free[info.accelerator] = [
-                SliceHandle(f"{info.accelerator}/slice-{i}", info.accelerator, info)
-                for i in range(n)
-            ]
+            grid = topo.host_grid_shape(info)
+            for i in range(n):
+                ps = PhysicalSlice(f"{info.accelerator}/slice-{i}", info)
+                self._slices[ps.slice_id] = (
+                    ps,
+                    [Box((0,) * len(grid), grid)],
+                )
+
+    # -- admission ----------------------------------------------------------
+
+    def _find_box(self, want_info: topo.SliceInfo) -> Optional[SliceHandle]:
+        """Carve one contiguous box shaped like ``want_info``'s host grid
+        from any compatible physical slice. Caller holds the lock."""
+        want_grid = topo.host_grid_shape(want_info)
+        for ps, free in self._slices.values():
+            if ps.info.generation != want_info.generation:
+                continue
+            if len(topo.host_grid_shape(ps.info)) != len(want_grid):
+                continue
+            # best fit: smallest free box that fits (least fragmentation)
+            candidates = [b for b in free if b.fits(want_grid)]
+            if not candidates:
+                continue
+            best = min(candidates, key=lambda b: b.hosts)
+            free.remove(best)
+            carved, remainders = _guillotine_split(best, want_grid)
+            free.extend(remainders)
+            return SliceHandle(
+                slice_id=f"{ps.slice_id}@{'x'.join(map(str, carved.origin))}",
+                accelerator=want_info.accelerator,
+                info=want_info,
+                physical=ps,
+                box=carved,
+            )
+        return None
 
     def admit(self, job: TPUJob) -> Optional[GangAssignment]:
-        """All-or-nothing: returns an assignment of ``num_slices`` whole
-        slices, or None if capacity is short. Idempotent per job uid."""
+        """All-or-nothing: returns an assignment of ``num_slices``
+        contiguous sub-slices, or None if capacity is short. Idempotent
+        per job uid."""
         uid = job.metadata.uid
         with self._lock:
             if uid in self._assigned:
@@ -96,10 +215,16 @@ class SliceAllocator:
                 ga = GangAssignment(uid, handles, hosts_per_slice=hosts_per_slice)
                 self._assigned[uid] = ga
                 return ga
-            free = self._free.get(info.accelerator, [])
-            if len(free) < want:
-                return None
-            handles = [free.pop() for _ in range(want)]
+
+            handles: List[SliceHandle] = []
+            for _ in range(want):
+                h = self._find_box(info)
+                if h is None:
+                    # all-or-nothing: roll back partial carves
+                    for got in handles:
+                        self._release_handle(got)
+                    return None
+                handles.append(h)
             ga = GangAssignment(uid, handles, hosts_per_slice=info.hosts)
             self._assigned[uid] = ga
             log.info(
@@ -107,23 +232,85 @@ class SliceAllocator:
             )
             return ga
 
+    def _release_handle(self, h: SliceHandle) -> None:
+        if h.physical is None or h.box is None:
+            return
+        _, free = self._slices[h.physical.slice_id]
+        free.append(h.box)
+        self._coalesce(free)
+
+    def _coalesce(self, free: List[Box]) -> None:
+        """Merge axis-adjacent same-shape boxes so released sub-slices
+        recombine into larger allocatable regions."""
+        merged = True
+        while merged:
+            merged = False
+            for i in range(len(free)):
+                for j in range(i + 1, len(free)):
+                    m = _try_merge(free[i], free[j])
+                    if m is not None:
+                        free[i] = m
+                        free.pop(j)
+                        merged = True
+                        break
+                if merged:
+                    break
+
     def assignment(self, job_uid: str) -> Optional[GangAssignment]:
         with self._lock:
             return self._assigned.get(job_uid)
 
     def release(self, job_uid: str) -> None:
-        """Return a gang's slices to the pool (job finished, deleted, or
+        """Return a gang's boxes to the pool (job finished, deleted, or
         gang-restarting after slice loss)."""
         with self._lock:
             ga = self._assigned.pop(job_uid, None)
             if ga is None:
                 return
             for h in ga.slices:
-                if not h.slice_id.startswith("cpu/"):
-                    self._free.setdefault(h.accelerator, []).append(h)
+                self._release_handle(h)
             log.info("released gang of job uid=%s", job_uid)
 
     def free_slices(self, accelerator: str) -> int:
+        """How many ``accelerator``-shaped sub-slices could be admitted
+        right now (counts carvable boxes, not just whole slices)."""
         with self._lock:
             info = topo.parse_accelerator(accelerator)
-            return len(self._free.get(info.accelerator, []))
+            grid = topo.host_grid_shape(info)
+            n = 0
+            for ps, free in self._slices.values():
+                if ps.info.generation != info.generation:
+                    continue
+                if len(topo.host_grid_shape(ps.info)) != len(grid):
+                    continue
+                for b in free:
+                    if b.fits(grid):
+                        # how many want-shaped tiles fit in this box
+                        tiles = 1
+                        for bs, ws in zip(b.shape, grid):
+                            tiles *= bs // ws
+                        n += tiles
+            return n
+
+
+def _try_merge(a: Box, b: Box) -> Optional[Box]:
+    """Merge two boxes iff they are flush along exactly one axis."""
+    for d in range(len(a.shape)):
+        same_other = all(
+            a.origin[i] == b.origin[i] and a.shape[i] == b.shape[i]
+            for i in range(len(a.shape))
+            if i != d
+        )
+        if not same_other:
+            continue
+        if a.origin[d] + a.shape[d] == b.origin[d]:
+            return Box(a.origin, tuple(
+                a.shape[i] + (b.shape[d] if i == d else 0)
+                for i in range(len(a.shape))
+            ))
+        if b.origin[d] + b.shape[d] == a.origin[d]:
+            return Box(b.origin, tuple(
+                b.shape[i] + (a.shape[d] if i == d else 0)
+                for i in range(len(a.shape))
+            ))
+    return None

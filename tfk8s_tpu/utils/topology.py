@@ -112,6 +112,73 @@ def _prime_factors(n: int) -> List[int]:
     return sorted(out, reverse=True)
 
 
+# -- host-grid geometry (ICI-topology-aware placement) -----------------------
+#
+# A slice's chip grid partitions into per-host blocks: each host owns an
+# ICI-contiguous sub-block (Cloud TPU reality: a v5p host is a 2x2x1
+# chunk of the chip torus). The gang allocator places jobs as
+# axis-aligned BOXES of host blocks, so every admitted gang is
+# ICI-contiguous by construction (north star: "gang scheduling and
+# placement become ICI-topology aware"; SURVEY.md §7 hard part 1).
+
+
+def host_block_shape(info: SliceInfo) -> Tuple[int, ...]:
+    """Per-host chip sub-block, greedily packed from the slowest topology
+    dim (v5p 2x2x4 with 4 chips/host -> (2, 2, 1), the x-y plane)."""
+    remaining = info.chips_per_host
+    block = []
+    for dim in info.topology:
+        b = math.gcd(dim, remaining)
+        block.append(b)
+        remaining //= b
+    if remaining != 1:
+        raise TopologyError(
+            f"{info.accelerator}: cannot tile {info.chips_per_host} "
+            f"chips/host into topology {info.topology}"
+        )
+    return tuple(block)
+
+
+def host_grid_shape(info: SliceInfo) -> Tuple[int, ...]:
+    """How the slice's hosts arrange as a grid of host blocks."""
+    block = host_block_shape(info)
+    return tuple(t // b for t, b in zip(info.topology, block))
+
+
+def host_coords(info: SliceInfo, host_index: int) -> Tuple[int, ...]:
+    """Host index -> coordinates in the host grid (C-order: last dim
+    fastest, so consecutive indices are grid-adjacent)."""
+    grid = host_grid_shape(info)
+    if not 0 <= host_index < info.hosts:
+        raise TopologyError(f"host {host_index} out of range for {info.accelerator}")
+    coords = []
+    rem = host_index
+    for dim in reversed(grid):
+        coords.append(rem % dim)
+        rem //= dim
+    return tuple(reversed(coords))
+
+
+def host_index_of(info: SliceInfo, coords: Tuple[int, ...]) -> int:
+    grid = host_grid_shape(info)
+    idx = 0
+    for c, dim in zip(coords, grid):
+        idx = idx * dim + c
+    return idx
+
+
+def hosts_contiguous(info: SliceInfo, host_indices) -> bool:
+    """True iff the hosts tile an axis-aligned box of the host grid —
+    the ICI-contiguity property the allocator guarantees."""
+    coords = [host_coords(info, h) for h in host_indices]
+    if not coords:
+        return False
+    lo = tuple(min(c[d] for c in coords) for d in range(len(coords[0])))
+    hi = tuple(max(c[d] for c in coords) for d in range(len(coords[0])))
+    vol = math.prod(h - l + 1 for l, h in zip(lo, hi))
+    return vol == len(set(coords)) == len(coords)
+
+
 def parse_accelerator(accelerator: str, topology: str = "") -> SliceInfo:
     """Resolve an accelerator type string (+ optional explicit topology) into
     a :class:`SliceInfo`. Raises :class:`TopologyError` on malformed or
