@@ -102,8 +102,12 @@ def _flash_speedup(seq: int = 2048, iters: int = 8):
         )
 
         def body(c, _):
-            dq, _dk, _dv = grad(c, k, v)
-            return c + 0.0 * dq.astype(c.dtype), ()  # chain the iterations
+            # ALL three grads feed the carry — leaving dk/dv out of the
+            # dependency chain lets XLA dead-code-eliminate the dkv half
+            # of the backward, which would undercount the timed work.
+            dq, dk, dv = grad(c, k, v)
+            chain = (dq + dk + dv).astype(c.dtype)
+            return c + 0.0 * chain, ()  # chain the iterations
 
         run = jax.jit(
             lambda q: jax.lax.scan(body, q, None, length=iters)[0]

@@ -174,22 +174,36 @@ def task_for_mesh(
     scores buffer starts dominating HBM; flash's is O(L·d))."""
     from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
     from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
-    from tfk8s_tpu.ops import flash_attention as fa
+    # NB: the ops package re-exports the flash_attention *function*,
+    # shadowing the submodule attribute — import symbols from the
+    # submodule directly.
+    from tfk8s_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_Q,
+        FLASH_SEQ_THRESHOLD,
+        _on_tpu,
+        flash_attention,
+    )
 
     cfg = cfg or base_config()
     seq_sharded = (
         AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
     )
-    seq_len = task_kw.get("seq_len", 128)
+    # The EFFECTIVE length — make_task clamps to cfg.max_len — decides
+    # the impl; flash's kernel additionally needs the length to divide
+    # its q/k blocks, so auto-selection requires a 512 multiple (the
+    # default block_q). Explicit cfg.attention_impl == "flash" trusts
+    # the caller's block sizes.
+    seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
     attn_fn = None
     if cfg.attention_impl == "ring" or seq_sharded:
         attn_fn = make_ring_attn_fn(mesh)
     elif cfg.attention_impl == "flash" or (
         cfg.attention_impl == "full"
-        and fa._on_tpu()
-        and seq_len >= fa.FLASH_SEQ_THRESHOLD
+        and _on_tpu()
+        and seq_len >= FLASH_SEQ_THRESHOLD
+        and seq_len % DEFAULT_BLOCK_Q == 0
     ):
-        attn_fn = fa.flash_attention
+        attn_fn = flash_attention
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 

@@ -12,10 +12,10 @@ Pallas kernels: the forward saves only the per-row logsumexp (O(L), not
 O(L²)); the backward recomputes probabilities blockwise from (q, k,
 lse) and accumulates
     dv += pᵀ·do,   ds = p∘(do·vᵀ − D),   dk += dsᵀ·q,   dq += ds·k
-with D = rowsum(do∘o) computed outside the kernels. Two kernels: one
-gridded over q blocks (dq), one over k blocks (dk, dv) — each
-accumulator lives in exactly one program, so no cross-program reduction
-races. Training (the measured workload) therefore runs flash end to end.
+with D = rowsum(do∘o) computed in-kernel from the o/do blocks already
+in VMEM. Two kernels: one gridded over q blocks (dq), one over k blocks
+(dk, dv) — each accumulator lives in exactly one program, so no
+cross-program reduction races. Training (the measured workload) therefore runs flash end to end.
 
 Causal masking is bottom-right aligned (matches ``_reference``'s tril
 with k=lk-lq); blocks entirely above the diagonal are skipped in all
@@ -43,19 +43,35 @@ _NEG = -1e30
 # buffer starts to hurt HBM (and eventually OOMs).
 FLASH_SEQ_THRESHOLD = 1024
 
+# Default q/k block sizes. Auto-selection (models/bert.py task_for_mesh)
+# requires the sequence length to be a DEFAULT_BLOCK_Q multiple so these
+# defaults divide it; explicit attention_impl="flash" configs may pass
+# their own blocks.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 256
+
+# Mosaic requires the last two dims of every block to be (8k, 128k) or
+# equal to the array dims, so the per-row logsumexp is stored broadcast
+# across a 128-lane minor dim (same layout as the stock jax TPU flash
+# kernel's l/m residuals) — the physical HBM tile is 128 lanes wide for
+# a 1-wide array anyway, so this costs nothing extra.
+_LSE_LANES = 128
+
 
 # -- forward -----------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causal: bool):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [BQ, d]
     block_q = q.shape[0]
     seq_len = k_ref.shape[1]
     num_kb = seq_len // block_k
 
-    m0 = jnp.full((block_q,), _NEG, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    # Per-row state lives as [BQ, 1] (2-D sublane-major — what Mosaic
+    # vectorizes well) rather than 1-D lane vectors.
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
 
     # Bottom-right-aligned causal mask (matches _reference's tril with
@@ -77,11 +93,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jnp.dot(
+        p = jnp.exp(s - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(
             p, vblk, preferred_element_type=jnp.float32
         )
         return m_new, l_new, acc_new
@@ -96,22 +112,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bo
         num_kb_eff = num_kb
     m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    if lse_ref is not None:  # saved only when a backward will need it
+        lse_ref[0] = jax.lax.broadcast_in_dim(
+            m + jnp.log(l_safe), (block_q, _LSE_LANES), (0, 1)
+        )
 
 
 # -- backward ----------------------------------------------------------------
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref,
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     *, block_k: int, causal: bool,
 ):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [BQ, d]
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)  # [BQ]
-    dvec = dvec_ref[0].astype(jnp.float32)  # [BQ]
+    lse = lse_ref[0][:, :1].astype(jnp.float32)  # [BQ, 1] (lane-broadcast store)
+    # D = rowsum(dO ∘ O), computed in-kernel from the blocks already in
+    # VMEM — cheaper than materializing a second lane-padded residual.
+    dvec = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
     block_q = q.shape[0]
     seq_len = k_ref.shape[1]
     num_kb = seq_len // block_k
@@ -131,9 +152,9 @@ def _bwd_dq_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG)
-        p = jnp.exp(s - lse[:, None])  # masked entries: exp(-inf) = 0
+        p = jnp.exp(s - lse)  # masked entries: exp(-inf) = 0
         dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - dvec[:, None])
+        ds = p * (dp - dvec)
         return acc + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
 
     if causal:
@@ -148,7 +169,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
     *, block_q: int, causal: bool,
 ):
     ki = pl.program_id(1)
@@ -168,18 +189,19 @@ def _bwd_dkv_kernel(
         dk, dv = carry
         qblk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         doblk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
-        dvec = dvec_ref[0, pl.ds(qb * block_q, block_q)].astype(jnp.float32)
+        oblk = o_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :1].astype(jnp.float32)
+        dvec = jnp.sum(doblk * oblk, axis=-1, keepdims=True)  # [BQ, 1]
         s = jnp.dot(qblk, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
         if causal:
             q_pos = offset + qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dv_new = dv + jnp.dot(p.T, doblk, preferred_element_type=jnp.float32)
         dp = jnp.dot(doblk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - dvec[:, None])
+        ds = p * (dp - dvec)
         dk_new = dk + jnp.dot(ds.T, qblk, preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -225,7 +247,7 @@ def _heads_minor(x, b, h):
     return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret, save_lse=True):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq = min(block_q, lq)
@@ -235,24 +257,28 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     )
     qr, kr, vr = _heads_major(q), _heads_major(k), _heads_major(v)
 
-    out, lse = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * h, lq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))]
+    if save_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, lq, _LSE_LANES), jnp.float32)
+        )
+        out_specs.append(
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j: (i, j, 0))
+        )
+    res = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=bk, causal=causal),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, lq), jnp.float32),
-        ),
+        out_shape=tuple(out_shape),
         grid=(b * h, lq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=(
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
-        ),
+        out_specs=tuple(out_specs),
         interpret=interpret,
     )(qr, kr, vr)
+    out, lse = res if save_lse else (res[0], None)
     return _heads_minor(out, b, h), lse
 
 
@@ -262,11 +288,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     bq = min(block_q, lq)
     bk = min(block_k, lk)
     qr, kr, vr = _heads_major(q), _heads_major(k), _heads_major(v)
-    dor = _heads_major(g)
-    # D = rowsum(dO ∘ O): O(L·d) elementwise, cheap under XLA fusion
-    dvec = jnp.sum(
-        dor.astype(jnp.float32) * _heads_major(o).astype(jnp.float32), axis=-1
-    )  # [b*h, lq]
+    dor, orr = _heads_major(g), _heads_major(o)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal),
@@ -277,12 +299,12 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),  # k
             pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),  # do
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),  # lse
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),  # D
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),  # o
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j: (i, j, 0)),  # lse
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
-    )(qr, kr, vr, dor, lse, dvec)
+    )(qr, kr, vr, dor, orr, lse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal),
@@ -296,15 +318,15 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),  # v
             pl.BlockSpec((1, lq, d), lambda i, j: (i, 0, 0)),  # do
-            pl.BlockSpec((1, lq), lambda i, j: (i, 0)),  # lse
-            pl.BlockSpec((1, lq), lambda i, j: (i, 0)),  # D
+            pl.BlockSpec((1, lq, d), lambda i, j: (i, 0, 0)),  # o
+            pl.BlockSpec((1, lq, _LSE_LANES), lambda i, j: (i, 0, 0)),  # lse
         ],
         out_specs=(
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
         ),
         interpret=interpret,
-    )(qr, kr, vr, dor, lse, dvec)
+    )(qr, kr, vr, dor, orr, lse)
 
     return (
         _heads_minor(dq, b, h),
@@ -320,7 +342,12 @@ def _on_tpu() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, block_q, block_k):
-    out, _lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, not _on_tpu())
+    # Primal (inference) path: skip the lse store entirely — pallas
+    # outputs aren't DCE'd by XLA, and the (b*h, lq, 128) f32 residual
+    # is 4x the bytes of the bf16 output itself.
+    out, _ = _flash_fwd_impl(
+        q, k, v, causal, block_q, block_k, not _on_tpu(), save_lse=False
+    )
     return out
 
 
@@ -345,8 +372,8 @@ def flash_attention(
     v: jax.Array,  # [b, lk, h, d]
     mask: Optional[jax.Array] = None,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 256,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
     """Drop-in for models.transformer.dot_product_attention (padding
     masks unsupported — pretraining data here is unpadded). Forward AND

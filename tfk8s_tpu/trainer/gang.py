@@ -71,9 +71,6 @@ class SliceHandle:
         a carved sub-slice (cpu/hermetic)."""
         if self.physical is None or self.box is None:
             return local_host
-        local = topo.host_coords(
-            dataclasses.replace(self.info), local_host
-        ) if False else None
         # local coords within the box, C-order over the box shape
         coords = []
         rem = local_host
@@ -96,13 +93,19 @@ class GangAssignment:
     slices: List[SliceHandle]
     hosts_per_slice: int
 
+    def handle_of(self, process_id: int) -> "SliceHandle":
+        """The ONE pid -> slice-handle mapping; every consumer (env
+        rendering, node selectors) goes through here."""
+        return self.slices[process_id // self.hosts_per_slice]
+
     def host_of(self, process_id: int) -> tuple:
         s, h = divmod(process_id, self.hosts_per_slice)
         return self.slices[s].slice_id, h
 
     def global_host_of(self, process_id: int) -> int:
-        s, h = divmod(process_id, self.hosts_per_slice)
-        return self.slices[s].global_host_index(h)
+        return self.handle_of(process_id).global_host_index(
+            process_id % self.hosts_per_slice
+        )
 
     @property
     def total_hosts(self) -> int:

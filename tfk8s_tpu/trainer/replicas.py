@@ -98,7 +98,7 @@ def render_pod(
         # allocator's placement rides the pod labels instead. Topology
         # info comes from the assignment's SliceHandle — parsed once at
         # admission, not per rendered pod.
-        sl = assignment.slices[pid // assignment.hosts_per_slice]
+        sl = assignment.handle_of(pid)
         info = sl.info
         resources.setdefault("google.com/tpu", str(info.chips_per_host))
         node_selector = {
@@ -108,10 +108,20 @@ def render_pod(
             ),
         }
     else:
+        # Node labels name PHYSICAL properties: a carved sub-slice's pods
+        # must select the parent slice's accelerator type, id, and the
+        # box-offset host index — real nodes are labeled with what they
+        # ARE, not what the job asked for (two jobs carved from one
+        # v5p-32 land on disjoint physical hosts of that v5p-32).
+        sl = assignment.handle_of(pid)
+        if sl.physical is not None:
+            phys_acc, phys_slice = sl.physical.info.accelerator, sl.physical.slice_id
+        else:
+            phys_acc, phys_slice = job.spec.tpu.accelerator, slice_id
         node_selector = {
-            "tfk8s.dev/accelerator": job.spec.tpu.accelerator,
-            "tfk8s.dev/slice": slice_id,
-            "tfk8s.dev/host": str(host_index),
+            "tfk8s.dev/accelerator": phys_acc,
+            "tfk8s.dev/slice": phys_slice,
+            "tfk8s.dev/host": str(assignment.global_host_of(pid)),
         }
     container = ContainerSpec(
         entrypoint=tmpl.entrypoint,

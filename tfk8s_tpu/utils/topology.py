@@ -123,19 +123,26 @@ def _prime_factors(n: int) -> List[int]:
 
 
 def host_block_shape(info: SliceInfo) -> Tuple[int, ...]:
-    """Per-host chip sub-block, greedily packed from the slowest topology
-    dim (v5p 2x2x4 with 4 chips/host -> (2, 2, 1), the x-y plane)."""
-    remaining = info.chips_per_host
-    block = []
-    for dim in info.topology:
-        b = math.gcd(dim, remaining)
-        block.append(b)
-        remaining //= b
-    if remaining != 1:
-        raise TopologyError(
-            f"{info.accelerator}: cannot tile {info.chips_per_host} "
-            f"chips/host into topology {info.topology}"
-        )
+    """Per-host chip sub-block: chips_per_host factored across the
+    topology dims as BALANCED as divisibility allows — each prime factor
+    lands on the smallest block dim that can still grow. This reproduces
+    the real machine geometry (a v4/v5p host owns a 2x2x1 chunk of the
+    chip torus, so v5p-128's (4,4,4) grid tiles into 2x2x1 host blocks —
+    NOT the (4,1,1) a greedy per-dim gcd would produce)."""
+    block = [1] * len(info.topology)
+    for p in sorted(_prime_factors(info.chips_per_host)):
+        candidates = [
+            i
+            for i, dim in enumerate(info.topology)
+            if dim % (block[i] * p) == 0
+        ]
+        if not candidates:
+            raise TopologyError(
+                f"{info.accelerator}: cannot tile {info.chips_per_host} "
+                f"chips/host into topology {info.topology}"
+            )
+        i = min(candidates, key=lambda i: block[i])
+        block[i] *= p
     return tuple(block)
 
 
