@@ -1,0 +1,178 @@
+"""Multislice (DCN-aware) mesh construction — VERDICT r1 missing #6.
+
+The reference scales by adding PS/WORKER replicas over gRPC
+(k8s-operator.md:6); the TPU equivalent of "more machines" is more
+SLICES, where intra-slice traffic rides ICI and inter-slice traffic
+rides DCN (SURVEY.md §2 'Distributed communication backend'). These
+tests pin the two load-bearing properties: devices are ordered
+slice-major so slice boundaries land on the slowest mesh axes, and
+ICI-hungry axes (tensor/sequence/expert) are rejected from spanning
+slices."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tfk8s_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    slice_major_devices,
+)
+from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh
+
+
+# -- axis split validation ---------------------------------------------------
+
+
+def test_split_puts_data_on_dcn_and_tensor_on_ici():
+    cfg = MeshConfig.create(data=2, fsdp=2, tensor=2)  # 8 devices
+    dcn, ici = cfg.slice_axis_split(2)
+    assert dcn == ("data",)
+    assert ici == ("fsdp", "tensor")
+
+
+def test_split_pipeline_over_dcn():
+    cfg = MeshConfig.create(pipeline=2, data=2, tensor=2)
+    dcn, ici = cfg.slice_axis_split(2)
+    assert dcn == ("pipeline",)
+    assert set(ici) == {"data", "tensor"}
+    # 4 slices: pipeline AND data cross DCN — both tolerate it
+    dcn4, ici4 = cfg.slice_axis_split(4)
+    assert dcn4 == ("pipeline", "data")
+    assert ici4 == ("tensor",)
+
+
+def test_split_allows_pure_dp_straddle():
+    """data=8 over 2 slices — THE canonical multislice config: the data
+    axis is partly ICI (within a slice) and partly DCN (across), which
+    data-parallel gradient all-reduce tolerates."""
+    dcn, ici = MeshConfig.create(data=8).slice_axis_split(2)
+    assert dcn == ("data",) and ici == ()
+    # fsdp straddling is likewise allowed
+    dcn, _ = MeshConfig.create(fsdp=4, tensor=2).slice_axis_split(2)
+    assert dcn == ("fsdp",)
+
+
+def test_split_rejects_tensor_across_slices():
+    cfg = MeshConfig.create(data=2, tensor=2)
+    with pytest.raises(ValueError, match="tensor"):
+        cfg.slice_axis_split(4)
+
+
+def test_split_rejects_tensor_straddling_boundary():
+    cfg = MeshConfig.create(tensor=8)
+    with pytest.raises(ValueError, match="tensor"):
+        cfg.slice_axis_split(2)
+
+
+def test_split_rejects_indivisible():
+    cfg = MeshConfig.create(data=2, tensor=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        cfg.slice_axis_split(4)
+
+
+def test_split_single_slice_is_all_ici():
+    cfg = MeshConfig.create(data=2, tensor=4)
+    dcn, ici = cfg.slice_axis_split(1)
+    assert dcn == () and ici == ("data", "tensor")
+
+
+# -- slice-major device ordering ---------------------------------------------
+
+
+@dataclasses.dataclass
+class FakeDev:
+    id: int
+    slice_index: int = None  # type: ignore[assignment]
+
+
+def test_slice_major_groups_by_slice_index():
+    # interleaved arrival order, as a real multi-host enumeration may give
+    devs = [
+        FakeDev(0, 1), FakeDev(1, 0), FakeDev(2, 1), FakeDev(3, 0),
+        FakeDev(4, 0), FakeDev(5, 1), FakeDev(6, 0), FakeDev(7, 1),
+    ]
+    out = slice_major_devices(devs, 2)
+    assert [d.slice_index for d in out] == [0] * 4 + [1] * 4
+    # within a slice: ordered by device id
+    assert [d.id for d in out[:4]] == sorted(d.id for d in devs if d.slice_index == 0)
+
+
+def test_slice_major_rejects_short_slice():
+    devs = [FakeDev(i, 0 if i < 3 else 1) for i in range(8)]
+    with pytest.raises(ValueError, match="need 4 per slice"):
+        slice_major_devices(devs, 2)
+
+
+def test_slice_major_rejects_too_few_slices():
+    devs = [FakeDev(i, 0) for i in range(8)]
+    with pytest.raises(ValueError, match="spans 1"):
+        slice_major_devices(devs, 2)
+
+
+def test_slice_major_subset_draws_evenly_from_slices():
+    """A mesh smaller than the pool must take want/num_slices devices
+    from EACH slice — a flat prefix would land entirely in slice 0."""
+    devs = [FakeDev(i, i // 8) for i in range(16)]  # 2 slices x 8
+    out = slice_major_devices(devs, 2, want=8)
+    assert [d.slice_index for d in out] == [0] * 4 + [1] * 4
+    assert [d.id for d in out] == [0, 1, 2, 3, 8, 9, 10, 11]
+
+
+def test_slice_major_virtual_chunks():
+    devs = [FakeDev(i) for i in range(8)]  # no slice_index -> emulation
+    assert slice_major_devices(devs, 2) == devs
+
+
+# -- built mesh geometry -----------------------------------------------------
+
+
+def test_multislice_mesh_slice_boundary_on_slow_axis():
+    """On the 8-device virtual pool, a 2-slice {data:2, fsdp:2, tensor:2}
+    mesh must put devices 0-3 (slice 0) at data=0 and 4-7 at data=1 —
+    i.e. every ICI axis stays within one emulated slice."""
+    mesh = make_mesh(data=2, fsdp=2, tensor=2, num_slices=2)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids.shape == (2, 2, 2)
+    slice_of = ids // 4  # emulated: first 4 device ids = slice 0
+    # data index == slice index for every fsdp/tensor coordinate
+    for di in range(2):
+        assert (slice_of[di] == di).all(), slice_of
+
+
+def test_multislice_mesh_rejects_bad_layout():
+    with pytest.raises(ValueError, match="tensor"):
+        make_mesh(tensor=8, num_slices=2)
+
+
+def test_launcher_builds_multislice_mesh_from_env():
+    ctx = ProcessContext.from_env(
+        {
+            "TFK8S_MESH": '{"data": 2, "tensor": 4}',
+            "TFK8S_NUM_SLICES": "2",
+        }
+    )
+    mesh = build_mesh(ctx)
+    assert mesh.shape == {"data": 2, "tensor": 4}
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert (ids[0] < 4).all() and (ids[1] >= 4).all()
+
+
+def test_multislice_train_step_runs():
+    """One jitted train step over a 2-slice mesh: GSPMD partitions with
+    the slice-major layout and the loss is finite."""
+    from tfk8s_tpu.models import bert
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    mesh = make_mesh(data=2, tensor=2, num_slices=2)
+    task = bert.make_task(cfg=bert.tiny_config(), seq_len=16, batch_size=8)
+    trainer = Trainer(task, TrainConfig(steps=1, learning_rate=1e-3), mesh)
+    state = trainer.init_state()
+    batch = jax.device_put(
+        task.make_batch(np.random.default_rng(0), task.batch_size),
+        trainer.batch_shardings,
+    )
+    _, metrics = trainer._step_fn(state, batch, jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))

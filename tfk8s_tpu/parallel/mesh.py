@@ -106,22 +106,126 @@ class MeshConfig:
                 return s
         return 1
 
-    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+    def slice_axis_split(self, num_slices: int) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+        """Partition the axes into (dcn_axes, ici_axes) for a
+        ``num_slices``-slice job. With slice-major device order and the
+        C-order mesh reshape, an axis's hops stay WITHIN one slice (pure
+        ICI) iff its span — (product of faster axes) x (its own size) —
+        divides devices-per-slice. Every other axis has at least one hop
+        crossing a slice boundary (pure DCN, or straddling: partly ICI
+        partly DCN — the canonical pure-DP-multislice layout, e.g.
+        ``data=8`` over 2 slices, straddles). Any DCN-touching axis must
+        be DCN-tolerant — the scaling-book rule: only pipeline / data /
+        fsdp gradient traffic tolerates DCN latency; tensor / sequence /
+        expert collectives sit on the critical path and must stay on ICI
+        (SURVEY.md §2 'DCN across slices')."""
+        n = self.size()
+        if num_slices <= 1:
+            return (), self.names
+        if n % num_slices:
+            raise ValueError(
+                f"mesh {dict(self.axes)} has {n} devices, not divisible "
+                f"into {num_slices} slices"
+            )
+        per_slice = n // num_slices
+        dcn: List[str] = []
+        ici: List[str] = []
+        stride = 1  # product of faster (later) axes
+        for name, size in reversed(self.axes):
+            span = stride * size
+            if size == 1 or per_slice % span == 0:
+                ici.append(name)
+            else:
+                dcn.append(name)
+                if name not in (AXIS_PIPELINE, AXIS_DATA, AXIS_FSDP):
+                    raise ValueError(
+                        f"mesh {dict(self.axes)}: axis {name!r} (size "
+                        f"{size}) would span slices (DCN) with "
+                        f"{per_slice} devices/slice; only {AXIS_PIPELINE}"
+                        f"/{AXIS_DATA}/{AXIS_FSDP} tolerate DCN latency "
+                        "— put tensor/sequence/expert parallelism inside "
+                        "a slice"
+                    )
+            stride = span
+        return tuple(reversed(dcn)), tuple(reversed(ici))
+
+    def build(
+        self,
+        devices: Optional[Sequence] = None,
+        num_slices: int = 1,
+    ) -> Mesh:
         """Reshape the device list into the canonical grid. With fewer
-        requested devices than available, uses a prefix (handy for tests)."""
+        requested devices than available, uses a prefix (handy for tests).
+
+        ``num_slices > 1`` builds a multislice (DCN-aware) mesh: devices
+        are ordered slice-major (``slice_major_devices``) and the axis
+        layout is validated by :meth:`slice_axis_split`, so intra-slice
+        collectives ride ICI and only pipeline/data/fsdp traffic crosses
+        DCN."""
         devices = list(jax.devices()) if devices is None else list(devices)
         n = self.size()
         if n > len(devices):
             raise ValueError(
                 f"mesh {dict(self.axes)} needs {n} devices; {len(devices)} available"
             )
+        if num_slices > 1:
+            self.slice_axis_split(num_slices)  # validate layout
+            # select from the FULL pool: a mesh smaller than a real
+            # multislice pool must draw evenly from each slice, not take
+            # a flat prefix (which could land entirely in slice 0)
+            devices = slice_major_devices(devices, num_slices, want=n)
         grid = np.array(devices[:n], dtype=object).reshape(self.shape)
         return Mesh(grid, self.names)
 
 
-def make_mesh(devices: Optional[Sequence] = None, **sizes: int) -> Mesh:
+def slice_major_devices(
+    devices: Sequence, num_slices: int, want: Optional[int] = None
+) -> List:
+    """Select ``want`` devices (default: all) ordered slice-major: all of
+    slice 0, then slice 1, … — so a C-order mesh reshape puts slice
+    boundaries on the slowest axes.
+
+    Real multislice TPU devices carry ``slice_index``; devices are
+    grouped by it, ordered by id within a slice, and ``want/num_slices``
+    are taken from each of the first ``num_slices`` slices. Virtual/CPU
+    device pools (hermetic tests, the driver's dryrun) have no
+    slice_index — the flat prefix is chunked into ``num_slices`` equal
+    contiguous groups, emulating slices."""
+    devs = list(devices)
+    want = len(devs) if want is None else want
+    if num_slices <= 1:
+        return devs[:want]
+    if want % num_slices:
+        raise ValueError(
+            f"{want} devices not divisible into {num_slices} slices"
+        )
+    per = want // num_slices
+    if any(getattr(d, "slice_index", None) is not None for d in devs):
+        by_slice: Dict[int, List] = {}
+        for d in devs:
+            by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+        if len(by_slice) < num_slices:
+            raise ValueError(
+                f"device pool spans {len(by_slice)} physical slices; job "
+                f"wants {num_slices}"
+            )
+        out: List = []
+        for s in sorted(by_slice)[:num_slices]:
+            grp = sorted(by_slice[s], key=lambda d: d.id)
+            if len(grp) < per:
+                raise ValueError(
+                    f"slice {s} has {len(grp)} devices; need {per} per slice"
+                )
+            out.extend(grp[:per])
+        return out
+    return devs[:want]  # emulation: contiguous chunks are the slices
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None, num_slices: int = 1, **sizes: int
+) -> Mesh:
     """One-call convenience: ``make_mesh(data=2, tensor=4)``."""
-    return MeshConfig.create(**sizes).build(devices)
+    return MeshConfig.create(**sizes).build(devices, num_slices=num_slices)
 
 
 def single_device_mesh() -> Mesh:

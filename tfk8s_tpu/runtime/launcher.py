@@ -128,5 +128,9 @@ def initialize_distributed(ctx: ProcessContext, env: Optional[Dict[str, str]] = 
 
 
 def build_mesh(ctx: ProcessContext):
+    """The job's logical mesh over the job's devices. Multislice jobs
+    (``TFK8S_NUM_SLICES`` > 1) get slice-major device order and the
+    DCN-axis validation of ``MeshConfig.slice_axis_split`` — data/
+    pipeline traffic crosses DCN, tensor/sequence/expert stay on ICI."""
     cfg = ctx.mesh or MeshConfig.create(data=jax.device_count())
-    return cfg.build()
+    return cfg.build(num_slices=max(ctx.num_slices, 1))
