@@ -143,7 +143,7 @@ def main() -> None:
             depth=50,
             num_classes=1000,
             image_size=224,
-            batch_size=int(os.environ.get("BENCH_BATCH", "128")),
+            batch_size=int(os.environ.get("BENCH_BATCH", "256")),
         )
         steps = 30
     sec_per_step = _time_task(rn_task, mesh, steps)
@@ -163,7 +163,7 @@ def main() -> None:
             seq_len=bert_seq,
             batch_size=int(os.environ.get("BENCH_BERT_BATCH", "64")),
         )
-        bsteps = 20
+        bsteps = 50
     bert_sec = _time_task(bert_task, mesh, bsteps)
 
     # -- flash-attention win at long sequence (VERDICT r2 item #6) ----------
@@ -175,11 +175,20 @@ def main() -> None:
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
+    baseline_note = {}
     if os.path.exists(baseline_path):
         try:
             prior = json.load(open(baseline_path))
             if prior.get("value"):
                 vs = value / float(prior["value"])
+                # an apples-to-apples ratio needs matching config; flag a
+                # mismatch rather than passing config drift off as a win
+                pb = prior.get("extra", {}).get("resnet_batch_size")
+                if pb is not None and pb != rn_task.batch_size:
+                    baseline_note = {
+                        "baseline_resnet_batch_size": pb,
+                        "baseline_config_mismatch": True,
+                    }
         except (ValueError, KeyError):
             pass
 
@@ -191,6 +200,7 @@ def main() -> None:
                 "unit": "images/sec/chip",
                 "vs_baseline": round(vs, 4),
                 "extra": {
+                    **baseline_note,
                     "bert_base_mlm_step_time_ms": round(bert_sec * 1000, 3),
                     "bert_batch_size": bert_task.batch_size,
                     "bert_seq_len": bert_seq,

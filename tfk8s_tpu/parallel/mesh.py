@@ -200,10 +200,11 @@ def slice_major_devices(
             f"{want} devices not divisible into {num_slices} slices"
         )
     per = want // num_slices
-    if any(getattr(d, "slice_index", None) is not None for d in devs):
+    has_index = [getattr(d, "slice_index", None) is not None for d in devs]
+    if all(has_index) and devs:
         by_slice: Dict[int, List] = {}
         for d in devs:
-            by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+            by_slice.setdefault(d.slice_index, []).append(d)
         if len(by_slice) < num_slices:
             raise ValueError(
                 f"device pool spans {len(by_slice)} physical slices; job "
@@ -218,6 +219,11 @@ def slice_major_devices(
                 )
             out.extend(grp[:per])
         return out
+    if any(has_index):
+        raise ValueError(
+            "device pool mixes slice-indexed and unindexed devices; "
+            "cannot infer a slice layout"
+        )
     return devs[:want]  # emulation: contiguous chunks are the slices
 
 
