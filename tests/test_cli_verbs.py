@@ -1,0 +1,111 @@
+"""kubectl-style CLI verbs (submit / get / describe / delete) over the
+remote client — the user-facing half of the reference workflow
+(`kubectl get pod` / `kubectl delete`, k8s-operator.md:50-52), driven
+against a live in-process apiserver across HTTP."""
+
+import json
+
+import pytest
+
+from tfk8s_tpu.api import serde
+from tfk8s_tpu.api.types import (
+    ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec,
+    TPUSpec,
+)
+from tfk8s_tpu.client.apiserver import APIServer
+from tfk8s_tpu.client.store import ClusterStore
+from tfk8s_tpu.cmd.main import main
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    server = APIServer(ClusterStore(), port=0)
+    server.serve_background()
+    kc = tmp_path / "kubeconfig.json"
+    kc.write_text(json.dumps({"server": server.url}))
+    try:
+        yield server, str(kc)
+    finally:
+        server.shutdown()
+
+
+def write_manifest(tmp_path, name="cli-job"):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint="test.echo")
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+        ),
+    )
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps(serde.to_dict(job)))
+    return str(path)
+
+
+def test_submit_get_describe_delete_roundtrip(cluster, tmp_path, capsys):
+    _server, kc = cluster
+    manifest = write_manifest(tmp_path)
+
+    assert main(["submit", "--kubeconfig", kc, "--file", manifest]) == 0
+    assert "cli-job created" in capsys.readouterr().out
+
+    assert main(["get", "--kubeconfig", kc]) == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "cli-job" in out and "Pending" in out
+
+    assert main(["get", "--kubeconfig", kc, "cli-job", "-o", "json"]) == 0
+    objs = json.loads(capsys.readouterr().out)
+    assert objs[0]["metadata"]["name"] == "cli-job"
+
+    assert main(["describe", "--kubeconfig", kc, "cli-job"]) == 0
+    detail = json.loads(capsys.readouterr().out)
+    assert detail["spec"]["replica_specs"]["Worker"]["replicas"] == 1
+
+    assert main(["delete", "--kubeconfig", kc, "cli-job"]) == 0
+    assert "deleted" in capsys.readouterr().out
+
+    # no finalizers were set by any controller here -> object is gone
+    assert main(["get", "--kubeconfig", kc, "cli-job"]) == 1
+    assert main(["delete", "--kubeconfig", kc, "cli-job"]) == 1
+
+
+def test_get_pods_empty_table(cluster, capsys):
+    _server, kc = cluster
+    assert main(["get", "--kubeconfig", kc, "--kind", "pods"]) == 0
+    assert "NAME" in capsys.readouterr().out
+
+
+def test_get_services_table(cluster, capsys):
+    """Services carry no status field — the table must render '-', not
+    crash (review finding)."""
+    from tfk8s_tpu.api.types import Service, ServiceSpec
+    from tfk8s_tpu.client.remote import RemoteStore
+
+    server, kc = cluster
+    RemoteStore(server.url).create(
+        Service(metadata=ObjectMeta(name="svc-0", namespace="default"),
+                spec=ServiceSpec())
+    )
+    assert main(["get", "--kubeconfig", kc, "--kind", "services"]) == 0
+    out = capsys.readouterr().out
+    assert "svc-0" in out and "-" in out
+
+
+def test_submit_namespace_flag_wins(cluster, tmp_path, capsys):
+    _server, kc = cluster
+    manifest = write_manifest(tmp_path, name="ns-job")
+    assert main(["submit", "--kubeconfig", kc, "-n", "prod", "--file", manifest]) == 0
+    assert main(["get", "--kubeconfig", kc, "-n", "prod", "ns-job"]) == 0
+    assert "ns-job" in capsys.readouterr().out
+
+
+def test_user_errors_exit_1_not_traceback(cluster, tmp_path):
+    _server, kc = cluster
+    assert main(["get", "--kubeconfig", str(tmp_path / "nope.json")]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"kind": "NoSuchKind", "metadata": {"name": "x"}}))
+    assert main(["submit", "--kubeconfig", kc, "--file", str(bad)]) == 1

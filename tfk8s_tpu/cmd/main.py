@@ -14,6 +14,10 @@ Subcommands:
                 — the L0 substrate as its own process
 - ``kubelet``   run the pod executor as its own process against a remote
                 apiserver (the node-agent half of the process split)
+- ``submit`` / ``get`` / ``describe`` / ``delete``  the kubectl verbs of
+                the reference workflow (k8s-operator.md:33-34 REST paths,
+                :50-52 ``kubectl get pod``), driven over the same remote
+                client the operator uses
 """
 
 from __future__ import annotations
@@ -68,6 +72,29 @@ def _build_parser() -> argparse.ArgumentParser:
     p_kl.add_argument("--kubeconfig", required=True)
     p_kl.add_argument("--name", default="kubelet-0",
                       help="node name recorded in pod status")
+
+    def kubectlish(name, help_):
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--kubeconfig", required=True)
+        p.add_argument("-n", "--namespace", default="default")
+        return p
+
+    p_sub = kubectlish("submit", "create a TPUJob from a manifest")
+    p_sub.add_argument("--file", required=True,
+                       help="TPUJob manifest (YAML or JSON)")
+
+    p_get = kubectlish("get", "list TPUJobs (or one by name)")
+    p_get.add_argument("name", nargs="?", default="")
+    p_get.add_argument("-o", "--output", choices=("table", "json"),
+                       default="table")
+    p_get.add_argument("--kind", default="tpujobs",
+                       choices=("tpujobs", "pods", "services"))
+
+    p_desc = kubectlish("describe", "full detail of one TPUJob")
+    p_desc.add_argument("name")
+
+    p_del = kubectlish("delete", "delete a TPUJob (finalizer-honoring)")
+    p_del.add_argument("name")
     return parser
 
 
@@ -231,6 +258,109 @@ def _cmd_kubelet(args: argparse.Namespace) -> int:
     return 0
 
 
+def _job_phase(job) -> str:
+    """Last True condition wins. Correct because ``helpers.set_condition``
+    keeps exclusive conditions (Running/Succeeded/Failed/Restarting)
+    mutually exclusive — at most one is True at a time."""
+    for cond in reversed(job.status.conditions):
+        if cond.status:
+            return str(getattr(cond.type, "value", cond.type))
+    return "Pending"
+
+
+def _age(ts) -> str:
+    import time
+
+    if not ts:
+        return "-"
+    s = max(0, int(time.time() - ts))
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    return f"{s // 3600}h"
+
+
+def _cmd_submit(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    job = load_manifest(args.file)
+    # -n always wins (matching _cmd_run): a manifest omitting the field
+    # decodes to "default", so "was it set?" is undetectable — warn only
+    # when the manifest visibly disagrees.
+    if job.metadata.namespace != args.namespace:
+        log.warning(
+            "submit: overriding manifest namespace %r with --namespace %r",
+            job.metadata.namespace, args.namespace,
+        )
+        job.metadata.namespace = args.namespace
+    created = cs.tpujobs(job.metadata.namespace).create(job)
+    print(f"tpujob {created.metadata.namespace}/{created.metadata.name} created")
+    return 0
+
+
+def _cmd_get(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    client = cs.generic(
+        {"tpujobs": "TPUJob", "pods": "Pod", "services": "Service"}[args.kind],
+        args.namespace,
+    )
+    if args.name:
+        objs = [client.get(args.name)]
+    else:
+        objs, _rv = client.list()
+    if args.output == "json":
+        print(json.dumps([serde.to_dict(o) for o in objs], indent=2))
+        return 0
+    if args.kind == "tpujobs":
+        rows = [("NAME", "PHASE", "RESTARTS", "AGE")] + [
+            (
+                j.metadata.name,
+                _job_phase(j),
+                str(j.status.gang_restarts),
+                _age(j.metadata.creation_timestamp),
+            )
+            for j in objs
+        ]
+    else:
+        def phase_of(o) -> str:
+            status = getattr(o, "status", None)  # Services carry no status
+            phase = getattr(status, "phase", "") if status is not None else ""
+            return str(getattr(phase, "value", phase)) or "-"
+
+        rows = [("NAME", "PHASE", "AGE")] + [
+            (o.metadata.name, phase_of(o), _age(o.metadata.creation_timestamp))
+            for o in objs
+        ]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    return 0
+
+
+def _cmd_describe(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    job = cs.tpujobs(args.namespace).get(args.name)
+    print(json.dumps(serde.to_dict(job), indent=2))
+    return 0
+
+
+def _cmd_delete(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    cs.tpujobs(args.namespace).delete(args.name)
+    print(f"tpujob {args.namespace}/{args.name} deleted")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.command == "train":
@@ -242,6 +372,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "kubelet":
         init_logging()
         return _cmd_kubelet(args)
+    if args.command in ("submit", "get", "describe", "delete"):
+        init_logging()
+        handler = {
+            "submit": _cmd_submit,
+            "get": _cmd_get,
+            "describe": _cmd_describe,
+            "delete": _cmd_delete,
+        }[args.command]
+        from tfk8s_tpu.client.store import StoreError
+
+        try:
+            return handler(args)
+        except StoreError as exc:
+            log.error("%s: %s", args.command, exc)
+            return 1
+        except (OSError, ValueError, KeyError) as exc:
+            # missing kubeconfig/manifest file, malformed manifest,
+            # unregistered kind — user errors, not stack traces
+            log.error("%s: %s: %s", args.command, type(exc).__name__, exc)
+            return 1
     opts = Options.from_args(args)
     init_logging(opts.log_level_int())
     if args.command == "operator":
