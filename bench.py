@@ -123,6 +123,14 @@ def _flash_speedup(seq: int = 2048, iters: int = 8):
 
 
 def main() -> None:
+    if os.environ.get("BENCH_PLATFORM"):
+        # e.g. BENCH_PLATFORM=cpu for the hermetic smoke test — env vars
+        # alone don't switch platforms here (sitecustomize imports jax at
+        # interpreter startup), so go through the launcher's latch-aware
+        # switch before the first backend query below.
+        from tfk8s_tpu.runtime.launcher import force_platform
+
+        force_platform(os.environ["BENCH_PLATFORM"])
     import jax
 
     from tfk8s_tpu.models import bert, resnet

@@ -109,3 +109,9 @@ def test_user_errors_exit_1_not_traceback(cluster, tmp_path):
     bad = tmp_path / "bad.json"
     bad.write_text(json.dumps({"kind": "NoSuchKind", "metadata": {"name": "x"}}))
     assert main(["submit", "--kubeconfig", kc, "--file", str(bad)]) == 1
+    broken = tmp_path / "broken.yaml"
+    broken.write_text("metadata: {name: x")  # unclosed mapping
+    assert main(["submit", "--kubeconfig", kc, "--file", str(broken)]) == 1
+    bare = tmp_path / "bare.yaml"
+    bare.write_text("just a string")
+    assert main(["submit", "--kubeconfig", kc, "--file", str(bare)]) == 1

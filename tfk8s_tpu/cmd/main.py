@@ -193,9 +193,16 @@ def load_manifest(path: str):
     try:
         import yaml
 
-        data = yaml.safe_load(text)
+        try:
+            data = yaml.safe_load(text)
+        except yaml.YAMLError as exc:
+            raise ValueError(f"{path}: malformed YAML: {exc}") from exc
     except ImportError:  # pragma: no cover — pyyaml is baked in
         data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"{path}: manifest must be a mapping, got {type(data).__name__}"
+        )
     return serde.decode_object(data)
 
 
