@@ -1,0 +1,160 @@
+"""Evaluator replica type (SURVEY.md C4: Chief/Worker/PS/Evaluator):
+the evaluator polls the job's checkpoint dir, evaluates each new
+checkpoint on held-out batches, and exits after evaluating the final
+training step. Unit level: run_eval against checkpoints written by a
+synchronous fit(). E2e: a Worker+Evaluator TPUJob through the
+controller, sharing the checkpoint-dir annotation."""
+
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+    SchedulingPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.models import mlp
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.runtime.train import TrainConfig, Trainer, run_eval
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
+
+
+def wait_for(pred, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_run_eval_evaluates_final_checkpoint(tmp_path):
+    from tfk8s_tpu.parallel.mesh import make_mesh
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    task = mlp.make_task()
+    mesh = make_mesh(data=1)
+    trainer = Trainer(
+        task,
+        TrainConfig(steps=120, learning_rate=3e-3, checkpoint_every=60,
+                    checkpoint_dir=ckpt_dir),
+        mesh,
+    )
+    trainer.fit()
+
+    metrics = run_eval(
+        task,
+        env={
+            "TFK8S_CHECKPOINT_DIR": ckpt_dir,
+            "TFK8S_TRAIN_STEPS": "120",
+            "TFK8S_EVAL_TIMEOUT": "60",
+        },
+        mesh=mesh,
+    )
+    assert metrics["step"] == 120.0
+    assert metrics["accuracy"] > 0.5  # held-out stream, real signal
+    assert "loss" in metrics
+
+
+def test_run_eval_times_out_without_checkpoints(tmp_path):
+    from tfk8s_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(RuntimeError, match="no new checkpoint"):
+        run_eval(
+            mlp.make_task(),
+            env={
+                "TFK8S_CHECKPOINT_DIR": str(tmp_path / "empty"),
+                "TFK8S_TRAIN_STEPS": "10",
+                "TFK8S_EVAL_TIMEOUT": "1",
+            },
+            mesh=make_mesh(data=1),
+        )
+
+
+EVAL_RESULTS = {}
+
+
+@registry.register("test.eval-capture")
+def _eval_capture(env, stop):
+    from tfk8s_tpu.runtime.train import run_eval as _run_eval
+
+    EVAL_RESULTS["metrics"] = _run_eval(mlp.make_task(), env, stop)
+
+
+def test_worker_plus_evaluator_job_e2e(tmp_path):
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-2": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    try:
+        name = "train-and-eval"
+        ckpt_dir = str(tmp_path / "ckpt")
+        job = TPUJob(
+            metadata=ObjectMeta(
+                name=name,
+                annotations={CHECKPOINT_DIR_ANNOTATION: ckpt_dir},
+            ),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=ContainerSpec(
+                            entrypoint="tfk8s_tpu.models.mlp:train",
+                            env={
+                                "TFK8S_TRAIN_STEPS": "300",
+                                "TFK8S_CHECKPOINT_EVERY": "100",
+                            },
+                        ),
+                    ),
+                    ReplicaType.EVALUATOR: ReplicaSpec(
+                        replicas=1,
+                        template=ContainerSpec(
+                            entrypoint="test.eval-capture",
+                            env={
+                                "TFK8S_TRAIN_STEPS": "300",
+                                "TFK8S_EVAL_TIMEOUT": "90",
+                            },
+                        ),
+                    ),
+                },
+                tpu=TPUSpec(accelerator="cpu-2"),
+                run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+            ),
+        )
+        EVAL_RESULTS.clear()
+        cs.tpujobs().create(job)
+
+        def succeeded():
+            try:
+                return helpers.has_condition(
+                    cs.tpujobs().get(name).status, JobConditionType.SUCCEEDED
+                )
+            except NotFound:
+                return False
+
+        assert wait_for(succeeded), (
+            f"job never succeeded; status={cs.tpujobs().get(name).status}"
+        )
+        # success keys off the WORKER (evaluator is not a compute replica);
+        # the evaluator must have evaluated at least one real checkpoint
+        assert wait_for(lambda: "metrics" in EVAL_RESULTS, timeout=30)
+        m = EVAL_RESULTS["metrics"]
+        assert m.get("step", 0) >= 100
+        assert "accuracy" in m
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()

@@ -1,0 +1,127 @@
+"""Multislice job e2e through the control plane: a TPUJob with
+``num_slices: 2`` flows spec → validation → gang admission (one handle
+per slice) → pod env (``TFK8S_NUM_SLICES`` / per-slice ``TFK8S_SLICE_ID``)
+→ launcher ``build_mesh`` (slice-major DCN-aware mesh) → training to
+Succeeded. Completes the VERDICT r1 multislice story end to end — the
+unit layer is tests/test_multislice.py."""
+
+import json
+import threading
+import time
+
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    MeshSpec,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+    SchedulingPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from tfk8s_tpu.api.validation import validate
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer import labels as L
+
+
+def wait_for(pred, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def make_multislice_job(name="ms-job", num_slices=2, workers=2):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ContainerSpec(
+                        entrypoint="tfk8s_tpu.models.mlp:train",
+                        env={"TFK8S_TRAIN_STEPS": "300"},
+                    ),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-2", num_slices=num_slices),
+            # pure-DP over the slice boundary: the canonical multislice
+            # layout (data straddles; DCN-tolerant)
+            mesh=MeshSpec(axes={"data": 4}),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+        ),
+    )
+
+
+def test_multislice_job_spec_validates():
+    job = make_multislice_job()
+    assert validate(job) == []
+    # mesh size must cover chips x num_slices
+    bad = make_multislice_job()
+    bad.spec.mesh = MeshSpec(axes={"data": 2})
+    assert any("mesh" in e for e in validate(bad))
+
+
+def test_multislice_job_runs_to_succeeded():
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-2": 4}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    try:
+        name = "ms-job"
+        cs.tpujobs().create(make_multislice_job(name))
+
+        def pods_up():
+            pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+            return len(pods) == 2
+
+        assert wait_for(pods_up)
+        pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+        envs = [p.spec.containers[0].env for p in pods]
+        for e in envs:
+            assert e["TFK8S_NUM_SLICES"] == "2"
+            assert json.loads(e["TFK8S_MESH"]) == {"data": 4}
+        # one worker per virtual slice -> two DISTINCT slice ids
+        assert len({e["TFK8S_SLICE_ID"] for e in envs}) == 2
+
+        def succeeded():
+            try:
+                return helpers.has_condition(
+                    cs.tpujobs().get(name).status, JobConditionType.SUCCEEDED
+                )
+            except NotFound:
+                return False
+
+        assert wait_for(succeeded), (
+            f"job never succeeded; status={cs.tpujobs().get(name).status}"
+        )
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()
+
+
+def test_multislice_env_builds_dcn_mesh_in_launcher():
+    """The worker-side contract: the exact env a multislice pod receives
+    yields a mesh whose data axis spans the emulated slice boundary."""
+    import numpy as np
+
+    from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh
+
+    ctx = ProcessContext.from_env(
+        {"TFK8S_MESH": '{"data": 4}', "TFK8S_NUM_SLICES": "2"}
+    )
+    mesh = build_mesh(ctx)
+    assert mesh.shape == {"data": 4}
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    # emulated slices are contiguous chunks: data 0-1 -> slice 0, 2-3 -> 1
+    assert list(ids) == sorted(ids)

@@ -115,3 +115,16 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     env.setdefault("TFK8S_TRAIN_STEPS", "300")
     env.setdefault("TFK8S_LEARNING_RATE", "3e-3")
     run_task(make_task(), env, stop)
+
+
+def evaluate(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint for the Evaluator replica type:
+    ``tfk8s_tpu.models.mlp:evaluate`` — evaluates each new checkpoint the
+    training replicas write (runtime.train.run_eval)."""
+    from tfk8s_tpu.runtime.train import run_eval
+
+    env = dict(env)
+    # must mirror train()'s default: the evaluator exits after evaluating
+    # this step, so both replicas need the same notion of "final"
+    env.setdefault("TFK8S_TRAIN_STEPS", "300")
+    run_eval(make_task(), env, stop)

@@ -61,6 +61,11 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         if not self.enabled:
             return None
+        # CheckpointManager caches its step listing at construction; an
+        # evaluator polling for checkpoints written by ANOTHER process
+        # (runtime.train.run_eval) needs a re-read to ever see them.
+        if hasattr(self._mgr, "reload"):
+            self._mgr.reload()
         return self._mgr.latest_step()
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:

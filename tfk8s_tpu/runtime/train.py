@@ -260,6 +260,82 @@ class Trainer:
         return state, history
 
 
+def run_eval(
+    task: TrainTask,
+    env: Optional[Dict[str, str]] = None,
+    stop: Optional[Any] = None,
+    mesh: Optional[Mesh] = None,
+) -> Dict[str, float]:
+    """Evaluator-replica entrypoint glue (the reference's Evaluator role,
+    SURVEY.md C4): poll the job's checkpoint dir, evaluate each NEW
+    checkpoint on fresh held-out batches, exit once the final training
+    step (``TFK8S_TRAIN_STEPS``) has been evaluated. Raises if no final
+    checkpoint appears within ``TFK8S_EVAL_TIMEOUT`` seconds — a failed
+    evaluator pod is how the control plane learns evaluation is wedged."""
+    env = dict(env or {})
+    ctx = ProcessContext.from_env(env)
+    if not ctx.checkpoint_dir:
+        raise RuntimeError(
+            f"{task.name}: evaluator needs TFK8S_CHECKPOINT_DIR "
+            "(set the tfk8s.dev/checkpoint-dir job annotation)"
+        )
+    # The evaluator is a rank in the job's coordination barrier
+    # (TFK8S_NUM_PROCESSES counts every replica, trainer/replicas.py) —
+    # skipping initialize would wedge the worker gang at startup.
+    initialize_distributed(ctx, env)
+    if mesh is None:
+        mesh = build_mesh(ctx)
+    final_step = int(env.get("TFK8S_TRAIN_STEPS", "0"))
+    timeout = float(env.get("TFK8S_EVAL_TIMEOUT", "300"))
+    eval_batches = int(env.get("TFK8S_EVAL_BATCHES", "4"))
+
+    trainer = Trainer(task, TrainConfig(steps=0), mesh)
+    state = trainer.init_state()  # shape/sharding donor for restore
+    eval_fn = jax.jit(task.loss_fn)
+    np_rng = np.random.default_rng(10_000)  # held-out stream
+    ckpt = Checkpointer(ctx.checkpoint_dir)
+
+    last_seen = -1
+    metrics: Dict[str, float] = {}
+    # timeout bounds time WITHOUT PROGRESS (a wedged evaluator/trainer),
+    # not total training duration — reset on every new checkpoint.
+    deadline = time.time() + timeout
+    try:
+        while time.time() < deadline:
+            if stop is not None and getattr(stop, "is_set", lambda: False)():
+                log.info("%s-eval: stop requested", task.name)
+                return metrics
+            step = ckpt.latest_step()
+            if step is not None and step > last_seen:
+                state = ckpt.restore(state, step=step)
+                sums: Dict[str, float] = {}
+                for _ in range(eval_batches):
+                    batch = jax.device_put(
+                        task.make_batch(np_rng, task.batch_size),
+                        trainer.batch_shardings,
+                    )
+                    loss, aux = eval_fn(state.params, batch, jax.random.key(0))
+                    for k, v in {"loss": loss, **aux}.items():
+                        sums[k] = sums.get(k, 0.0) + float(v)
+                metrics = {k: v / eval_batches for k, v in sums.items()}
+                metrics["step"] = float(step)
+                log.info(
+                    "%s-eval step %d: %s", task.name, step,
+                    {k: round(v, 4) for k, v in metrics.items()},
+                )
+                last_seen = step
+                if final_step and step >= final_step:
+                    return metrics
+                deadline = time.time() + timeout  # progress -> new window
+            time.sleep(0.2)
+    finally:
+        ckpt.close()
+    raise RuntimeError(
+        f"{task.name}: evaluator saw no new checkpoint (step > {last_seen}) "
+        f"for {timeout:.0f}s (final step wanted: {final_step})"
+    )
+
+
 def run_task(
     task: TrainTask,
     env: Optional[Dict[str, str]] = None,

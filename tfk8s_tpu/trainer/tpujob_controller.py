@@ -318,9 +318,14 @@ class TPUJobController:
             existing = helpers.get_condition(
                 job.status, JobConditionType.RESTARTING
             )
+            # Deliberately ignore existing.status: a stale Failed-pod
+            # event can arrive AFTER the restarted gang went Running
+            # (which flips RESTARTING to False) — the failed set's UIDs,
+            # baked into the message, are the episode's real identity.
+            # Recreated pods get fresh UIDs, so a genuine second failure
+            # still produces a new message and is counted.
             already_counted = (
                 existing is not None
-                and existing.status
                 and existing.message
                 == self._gang_restart_message(job.status.gang_restarts, failed_ids)
             )
