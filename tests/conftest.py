@@ -18,3 +18,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tfk8s_tpu.runtime.launcher import force_platform  # noqa: E402
 
 assert force_platform("cpu", 8), "JAX backend already initialized before conftest"
+
+
+def wait_for(pred, timeout=120.0, interval=0.05):
+    """Poll ``pred`` until truthy or ``timeout`` seconds pass. The one
+    shared copy — individual test modules should import this instead of
+    redefining it."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False

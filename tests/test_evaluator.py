@@ -6,7 +6,6 @@ synchronous fit(). E2e: a Worker+Evaluator TPUJob through the
 controller, sharing the checkpoint-dir annotation."""
 
 import threading
-import time
 
 import pytest
 
@@ -31,13 +30,7 @@ from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
 
 
-def wait_for(pred, timeout=120.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
+from conftest import wait_for
 
 
 def test_run_eval_evaluates_final_checkpoint(tmp_path):
@@ -155,6 +148,67 @@ def test_worker_plus_evaluator_job_e2e(tmp_path):
         m = EVAL_RESULTS["metrics"]
         assert m.get("step", 0) >= 100
         assert "accuracy" in m
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()
+
+
+@registry.register("test.eval-crash")
+def _eval_crash(env, stop):
+    raise RuntimeError("synthetic evaluator crash")
+
+
+def test_evaluator_failure_does_not_kill_the_gang(tmp_path):
+    """An evaluator crash is NOT slice loss: the training gang must keep
+    running (no gang restart burned) and the job still Succeeds off the
+    worker — the failed evaluator pod is left for inspection."""
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-2": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    try:
+        name = "eval-crash-job"
+        job = TPUJob(
+            metadata=ObjectMeta(name=name),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=ContainerSpec(
+                            entrypoint="tfk8s_tpu.models.mlp:train",
+                            env={"TFK8S_TRAIN_STEPS": "300"},
+                        ),
+                    ),
+                    ReplicaType.EVALUATOR: ReplicaSpec(
+                        replicas=1,
+                        template=ContainerSpec(entrypoint="test.eval-crash"),
+                    ),
+                },
+                tpu=TPUSpec(accelerator="cpu-2"),
+                run_policy=RunPolicy(
+                    scheduling=SchedulingPolicy(gang=True), backoff_limit=2
+                ),
+            ),
+        )
+        cs.tpujobs().create(job)
+
+        def succeeded():
+            try:
+                return helpers.has_condition(
+                    cs.tpujobs().get(name).status, JobConditionType.SUCCEEDED
+                )
+            except NotFound:
+                return False
+
+        assert wait_for(succeeded), (
+            f"job never succeeded; status={cs.tpujobs().get(name).status}"
+        )
+        final = cs.tpujobs().get(name)
+        assert final.status.gang_restarts == 0  # no gang restart burned
+        assert final.status.replica_statuses[ReplicaType.WORKER].succeeded == 1
+        assert final.status.replica_statuses[ReplicaType.EVALUATOR].failed >= 1
     finally:
         stop.set()
         ctrl.controller.shutdown()

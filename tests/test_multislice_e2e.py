@@ -7,7 +7,6 @@ unit layer is tests/test_multislice.py."""
 
 import json
 import threading
-import time
 
 from tfk8s_tpu.api import helpers
 from tfk8s_tpu.api.types import (
@@ -30,13 +29,7 @@ from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer import labels as L
 
 
-def wait_for(pred, timeout=120.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
+from conftest import wait_for
 
 
 def make_multislice_job(name="ms-job", num_slices=2, workers=2):
@@ -123,5 +116,14 @@ def test_multislice_env_builds_dcn_mesh_in_launcher():
     mesh = build_mesh(ctx)
     assert mesh.shape == {"data": 4}
     ids = np.vectorize(lambda d: d.id)(mesh.devices)
-    # emulated slices are contiguous chunks: data 0-1 -> slice 0, 2-3 -> 1
-    assert list(ids) == sorted(ids)
+    # emulated slices are contiguous chunks of the pool: data coords 0-1
+    # must map to slice-0 devices {0,1} and coords 2-3 to slice-1 {2,3}
+    assert list(ids) == [0, 1, 2, 3], ids
+    # and an ICI-hostile layout must be rejected through the same path
+    bad = ProcessContext.from_env(
+        {"TFK8S_MESH": '{"tensor": 8}', "TFK8S_NUM_SLICES": "2"}
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="tensor"):
+        build_mesh(bad)

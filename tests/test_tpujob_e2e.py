@@ -73,13 +73,7 @@ def cluster():
     ctrl.controller.shutdown()
 
 
-def wait_for(pred, timeout=10.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.02)
-    return False
+from conftest import wait_for
 
 
 def get_job(cs, name):
@@ -277,3 +271,22 @@ def test_ttl_deletes_finished_job(cluster):
             return True
 
     assert wait_for(job_gone, timeout=20)
+
+
+def test_chief_is_the_completion_oracle(cluster):
+    """With a CHIEF replica present, job success keys off the chief ALONE
+    (SURVEY.md C4 'master/chief per north star'): the chief finishing
+    marks the job Succeeded even while workers would keep running (the
+    reference's PS-style workers never exit on their own)."""
+    cs, ctrl, stop = cluster
+    j = make_job("chief-job", workers=1, entrypoint="test.block-until-stopped")
+    j.spec.replica_specs[ReplicaType.CHIEF] = ReplicaSpec(
+        replicas=1, template=ContainerSpec(entrypoint="test.echo")
+    )
+    cs.tpujobs().create(j)
+
+    assert wait_for(lambda: job_has(cs, "chief-job", JobConditionType.SUCCEEDED))
+    final = get_job(cs, "chief-job")
+    assert final.status.replica_statuses[ReplicaType.CHIEF].succeeded == 1
+    # the worker never finished by itself — success came from the chief
+    assert final.status.replica_statuses[ReplicaType.WORKER].succeeded == 0

@@ -66,6 +66,12 @@ class Checkpointer:
         # (runtime.train.run_eval) needs a re-read to ever see them.
         if hasattr(self._mgr, "reload"):
             self._mgr.reload()
+        elif not getattr(self, "_warned_no_reload", False):
+            self._warned_no_reload = True
+            log.warning(
+                "orbax CheckpointManager has no reload(); cross-process "
+                "pollers will only see checkpoints that existed at open time"
+            )
         return self._mgr.latest_step()
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:

@@ -290,7 +290,14 @@ def run_eval(
     eval_batches = int(env.get("TFK8S_EVAL_BATCHES", "4"))
 
     trainer = Trainer(task, TrainConfig(steps=0), mesh)
-    state = trainer.init_state()  # shape/sharding donor for restore
+    # ABSTRACT donor for restore — shapes+shardings without materializing
+    # params or optimizer state on device: the evaluator only ever holds
+    # one restored state (and uses only its params).
+    state = jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        jax.eval_shape(trainer._init_fn, jax.random.key(0)),
+        trainer.state_shardings,
+    )
     eval_fn = jax.jit(task.loss_fn)
     np_rng = np.random.default_rng(10_000)  # held-out stream
     ckpt = Checkpointer(ctx.checkpoint_dir)

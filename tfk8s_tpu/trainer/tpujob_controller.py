@@ -111,6 +111,9 @@ class TPUJobController:
         self._uid_by_key: dict = {}
         # pod name -> restart count to stamp on the next recreation
         self._pending_restart_counts: dict = {}
+        # evaluator pod uids whose terminal failure was already recorded
+        # (their Failed pods persist, re-observed by every reconcile)
+        self._evaluator_failures_seen: set = set()
 
     def _enqueue_owner(self, obj) -> None:
         meta = getattr(obj, "obj", obj).metadata  # unwrap DeletedFinalStateUnknown
@@ -280,9 +283,21 @@ class TPUJobController:
         ns = job.metadata.namespace
         gang_mode = job.spec.run_policy.scheduling.gang
 
+        def _is_evaluator(pod: Pod) -> bool:
+            return (
+                pod.metadata.labels.get(L.REPLICA_TYPE)
+                == ReplicaType.EVALUATOR.value
+            )
+
         # Replica-level policy: Never means a failure is permanent.
+        # Evaluator failures are never JOB-fatal (success keys off the
+        # compute replicas) — a Never-policy evaluator is left Failed
+        # for inspection and dropped from further handling.
         for pod in failed:
             if pod.spec.restart_policy == RestartPolicy.NEVER:
+                if _is_evaluator(pod):
+                    self._record_evaluator_failure(key, pod)
+                    continue
                 helpers.set_condition(
                     job.status, JobConditionType.FAILED,
                     reason="PodFailed",
@@ -291,8 +306,20 @@ class TPUJobController:
                 self.recorder.event("TPUJob", key, "PodFailed", pod.metadata.name)
                 self._write_status(job)
                 return True
+        failed = [
+            p for p in failed
+            if not (p.spec.restart_policy == RestartPolicy.NEVER and _is_evaluator(p))
+        ]
 
-        if gang_mode:
+        # Evaluator pods sit OUTSIDE the compute gang: they hold no slice
+        # chips, so an evaluator crash is not slice loss — restart it in
+        # place instead of burning a gang restart of healthy training
+        # replicas (a wedged evaluator would otherwise cycle the whole job
+        # to BackoffLimitExceeded).
+        gang_failed = [p for p in failed if not _is_evaluator(p)]
+
+        if gang_mode and gang_failed:
+            failed = gang_failed  # evaluators don't drive gang accounting
             # Slice loss is gang loss: restart everything from checkpoint
             # (SURVEY.md §2 'Elastic / gang semantics').
             limit = job.spec.run_policy.backoff_limit or 0
@@ -361,6 +388,10 @@ class TPUJobController:
                 rspec = job.spec.replica_specs.get(ReplicaType(rt))
             max_restarts = rspec.max_restarts if rspec else 0
             if restarts >= (max_restarts or 0):
+                if _is_evaluator(pod):
+                    # exhausted evaluator: left Failed, job unaffected
+                    self._record_evaluator_failure(key, pod)
+                    continue
                 helpers.set_condition(
                     job.status, JobConditionType.FAILED,
                     reason="BackoffLimitExceeded",
@@ -378,6 +409,15 @@ class TPUJobController:
             # namespaces can't cross-contaminate lineage).
             self._pending_restart_counts[pod.metadata.key] = restarts + 1
         return False
+
+    def _record_evaluator_failure(self, key: str, pod: Pod) -> None:
+        """Once-per-pod-uid event: the terminally-Failed evaluator pod is
+        kept around, so every subsequent reconcile re-observes it — without
+        dedup the event log floods."""
+        if pod.metadata.uid in self._evaluator_failures_seen:
+            return
+        self._evaluator_failures_seen.add(pod.metadata.uid)
+        self.recorder.event("TPUJob", key, "EvaluatorFailed", pod.metadata.name)
 
     def _delete_pod(self, ns: str, name: str) -> None:
         try:
