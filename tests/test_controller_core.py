@@ -10,6 +10,8 @@ from tfk8s_tpu.api import ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, T
 from tfk8s_tpu.client import FakeClientset, SharedIndexInformer
 from tfk8s_tpu.controller import Controller, LeaderElector
 
+from conftest import wait_for
+
 
 def job(name="j1"):
     return TPUJob(
@@ -33,14 +35,6 @@ def start_controller(cs, sync, **kw):
     assert ok
     return ctrl, inf, stop
 
-
-def wait_for(pred, timeout=5.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.01)
-    return False
 
 
 def test_controller_syncs_created_objects():

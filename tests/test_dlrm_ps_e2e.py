@@ -9,7 +9,6 @@ role) matches what a reference user would submit.
 """
 
 import threading
-import time
 
 import jax
 import pytest
@@ -31,6 +30,8 @@ from tfk8s_tpu.runtime import LocalKubelet
 from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer import labels as L
 
+from conftest import wait_for
+
 
 @pytest.fixture
 def cluster():
@@ -44,14 +45,6 @@ def cluster():
     stop.set()
     ctrl.controller.shutdown()
 
-
-def wait_for(pred, timeout=120.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
 
 
 def test_ps_worker_dlrm_job_trains_with_sharded_embeddings(cluster):

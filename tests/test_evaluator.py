@@ -29,8 +29,8 @@ from tfk8s_tpu.runtime.train import TrainConfig, Trainer, run_eval
 from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
 
-
 from conftest import wait_for
+
 
 
 def test_run_eval_evaluates_final_checkpoint(tmp_path):

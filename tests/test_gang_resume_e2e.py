@@ -10,7 +10,6 @@ whole-job restart-from-checkpoint).
 """
 
 import threading
-import time
 
 import pytest
 
@@ -30,6 +29,8 @@ from tfk8s_tpu.client import FakeClientset, NotFound
 from tfk8s_tpu.runtime import LocalKubelet, registry
 from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
+
+from conftest import wait_for
 
 OBS = {}
 
@@ -76,14 +77,6 @@ def cluster():
     stop.set()
     ctrl.controller.shutdown()
 
-
-def wait_for(pred, timeout=120.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
 
 
 def test_gang_restart_resumes_training_from_checkpoint(cluster, tmp_path):

@@ -1,0 +1,22 @@
+"""The shipped example manifests must stay loadable and valid against the
+current API — they are the first thing a reference user submits
+(README quick start; `cmd/main.py submit --file`)."""
+
+import glob
+import os
+
+from tfk8s_tpu.api import defaults, validation
+from tfk8s_tpu.cmd.main import load_manifest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_example_manifests_decode_default_validate():
+    paths = sorted(glob.glob(os.path.join(REPO, "manifests", "examples", "*.yaml")))
+    assert paths, "no example manifests found"
+    for path in paths:
+        job = load_manifest(path)
+        defaults.set_defaults(job)
+        errs = validation.validate(job)
+        assert errs == [], f"{os.path.basename(path)}: {errs}"
+        assert job.spec.replica_specs, path

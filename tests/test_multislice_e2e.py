@@ -28,8 +28,8 @@ from tfk8s_tpu.runtime import LocalKubelet
 from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer import labels as L
 
-
 from conftest import wait_for
+
 
 
 def make_multislice_job(name="ms-job", num_slices=2, workers=2):

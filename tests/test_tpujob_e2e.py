@@ -28,6 +28,8 @@ from tfk8s_tpu.runtime import LocalKubelet, registry
 from tfk8s_tpu.trainer import FINALIZER, SliceAllocator, TPUJobController
 from tfk8s_tpu.trainer import labels as L
 
+from conftest import wait_for
+
 RESULTS = {}
 
 
@@ -72,8 +74,6 @@ def cluster():
     stop.set()
     ctrl.controller.shutdown()
 
-
-from conftest import wait_for
 
 
 def get_job(cs, name):
@@ -290,3 +290,57 @@ def test_chief_is_the_completion_oracle(cluster):
     assert final.status.replica_statuses[ReplicaType.CHIEF].succeeded == 1
     # the worker never finished by itself — success came from the chief
     assert final.status.replica_statuses[ReplicaType.WORKER].succeeded == 0
+
+
+def test_admission_timeout_fails_pending_gang(cluster):
+    """SchedulingPolicy.admission_timeout_s: a gang that can't be placed
+    within the window goes Failed/AdmissionTimeout instead of Pending
+    forever."""
+    cs, ctrl, stop = cluster
+    # 3 slices x 4 hosts: replica count must match the host count for the
+    # spec to validate; the inventory holds only 2 slices -> never admitted
+    j = make_job("starved", workers=12, accelerator="v5litepod-16")
+    j.spec.tpu.num_slices = 3
+    j.spec.run_policy.scheduling.admission_timeout_s = 0.4
+    cs.tpujobs().create(j)
+
+    assert wait_for(lambda: job_has(cs, "starved", JobConditionType.FAILED), timeout=30)
+    cond = helpers.get_condition(
+        get_job(cs, "starved").status, JobConditionType.FAILED
+    )
+    assert cond.reason == "AdmissionTimeout"
+    # nothing was ever scheduled
+    assert cs.pods().list(label_selector=L.job_selector("starved"))[0] == []
+
+
+def test_active_deadline_kills_overrunning_job(cluster):
+    """RunPolicy.active_deadline_seconds: a job running past its deadline
+    is Failed/DeadlineExceeded and its pods are torn down."""
+    cs, ctrl, stop = cluster
+    j = make_job("overrun", entrypoint="test.block-until-stopped")
+    j.spec.run_policy.active_deadline_seconds = 0.5
+    cs.tpujobs().create(j)
+
+    assert wait_for(lambda: job_has(cs, "overrun", JobConditionType.FAILED), timeout=30)
+    cond = helpers.get_condition(
+        get_job(cs, "overrun").status, JobConditionType.FAILED
+    )
+    assert cond.reason == "DeadlineExceeded"
+    assert wait_for(
+        lambda: cs.pods().list(label_selector=L.job_selector("overrun"))[0] == []
+    )
+
+
+def test_capacity_gauges_exported(cluster):
+    """The allocator's free-slice inventory is exported as gauges on every
+    admit/release transition (served at /metrics by cmd/server.py)."""
+    cs, ctrl, stop = cluster
+    j = make_job("gaugejob", workers=4, accelerator="v5litepod-16",
+                 entrypoint="test.block-until-stopped")
+    cs.tpujobs().create(j)
+    assert wait_for(lambda: job_has(cs, "gaugejob", JobConditionType.RUNNING))
+    assert ctrl.metrics.gauges.get("gang.free_slices.v5litepod-16") == 1.0
+    cs.tpujobs().delete("gaugejob")
+    assert wait_for(
+        lambda: ctrl.metrics.gauges.get("gang.free_slices.v5litepod-16") == 2.0
+    )

@@ -202,6 +202,16 @@ class Trainer:
     def init_state(self) -> TrainState:
         return self._init_fn(jax.random.key(self.config.seed))
 
+    def abstract_state(self) -> TrainState:
+        """Shapes + shardings of the train state WITHOUT materializing
+        anything on device — the restore donor for processes that only
+        read checkpoints (run_eval)."""
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            jax.eval_shape(self._init_fn, jax.random.key(self.config.seed)),
+            self.state_shardings,
+        )
+
     def fit(
         self,
         state: Optional[TrainState] = None,
@@ -293,11 +303,7 @@ def run_eval(
     # ABSTRACT donor for restore — shapes+shardings without materializing
     # params or optimizer state on device: the evaluator only ever holds
     # one restored state (and uses only its params).
-    state = jax.tree_util.tree_map(
-        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
-        jax.eval_shape(trainer._init_fn, jax.random.key(0)),
-        trainer.state_shardings,
-    )
+    state = trainer.abstract_state()
     eval_fn = jax.jit(task.loss_fn)
     np_rng = np.random.default_rng(10_000)  # held-out stream
     ckpt = Checkpointer(ctx.checkpoint_dir)

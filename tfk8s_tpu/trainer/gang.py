@@ -152,6 +152,9 @@ class SliceAllocator:
         self._slices: Dict[str, Tuple[PhysicalSlice, List[Box]]] = {}
         self._assigned: Dict[str, GangAssignment] = {}
         self._cpu_counter = 0
+        # bumped on every inventory transition (placement / release) so
+        # observers (capacity gauges) can skip recomputing when idle
+        self.version = 0
         for acc, n in (capacity or {}).items():
             info = topo.parse_accelerator(acc)
             grid = topo.host_grid_shape(info)
@@ -230,6 +233,7 @@ class SliceAllocator:
                 handles.append(h)
             ga = GangAssignment(uid, handles, hosts_per_slice=info.hosts)
             self._assigned[uid] = ga
+            self.version += 1
             log.info(
                 "admitted job uid=%s onto %s", uid, [h.slice_id for h in handles]
             )
@@ -272,7 +276,17 @@ class SliceAllocator:
                 return
             for h in ga.slices:
                 self._release_handle(h)
+            self.version += 1
             log.info("released gang of job uid=%s", job_uid)
+
+    def capacity_summary(self) -> Dict[str, int]:
+        """Free whole-slice count per physical accelerator type in the
+        inventory — the operator exports these as per-accelerator gauges
+        (``gang.free_slices.<accelerator>`` on /metrics, e.g.
+        ``gang_free_slices_v5litepod_16`` after Prometheus sanitization)."""
+        with self._lock:
+            accs = sorted({ps.info.accelerator for ps, _ in self._slices.values()})
+        return {acc: self.free_slices(acc) for acc in accs}
 
     def free_slices(self, accelerator: str) -> int:
         """How many ``accelerator``-shaped sub-slices could be admitted

@@ -114,6 +114,11 @@ class TPUJobController:
         # evaluator pod uids whose terminal failure was already recorded
         # (their Failed pods persist, re-observed by every reconcile)
         self._evaluator_failures_seen: set = set()
+        # job key -> gang_restarts floor: the recreate sync can run off a
+        # stale cached job whose status predates the increment write; a
+        # pod rendered with the old TFK8S_GANG_RESTARTS would repeat the
+        # pre-restart run and burn a second unit of backoff_limit
+        self._gang_restarts_floor: dict = {}
 
     def _enqueue_owner(self, obj) -> None:
         meta = getattr(obj, "obj", obj).metadata  # unwrap DeletedFinalStateUnknown
@@ -134,6 +139,8 @@ class TPUJobController:
             uid = self._uid_by_key.pop(key, None)
             if uid:
                 self.allocator.release(uid)
+                self._export_capacity_gauges()
+            self._prune_evaluator_failures(key)
             return
 
         if job.metadata.deletion_timestamp is not None:
@@ -176,6 +183,7 @@ class TPUJobController:
 
         # Gang admission (SURVEY.md §7 hard part 1)
         ga = self.allocator.admit(job)
+        self._export_capacity_gauges()
         if ga is None:
             self.recorder.event(
                 "TPUJob", key, "GangPending",
@@ -224,6 +232,12 @@ class TPUJobController:
 
     def _reconcile_replicas(self, job: TPUJob, ga, status_changed: bool) -> None:
         ns, key = job.metadata.namespace, job.metadata.key
+        # Never render from a stale restart count (informer cache may lag
+        # the increment write by a sync or two) — the recreated gang's
+        # TFK8S_GANG_RESTARTS / resume contract depends on it.
+        floor = self._gang_restarts_floor.get(key, 0)
+        if job.status.gang_restarts < floor:
+            job.status.gang_restarts = floor
         desired_pods, desired_svcs = R.render_all(job, ga)
         desired_names = {p.metadata.name for p in desired_pods}
         desired_svc_names = {s.metadata.name for s in desired_svcs}
@@ -372,6 +386,9 @@ class TPUJobController:
                 # (restart without trace).
                 if not self._write_status(job):
                     return True
+                # Floor for stale-cache syncs: the recreate pass must
+                # never render pods with a pre-increment restart count.
+                self._gang_restarts_floor[key] = job.status.gang_restarts
                 self.recorder.event(
                     "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
                 )
@@ -410,14 +427,36 @@ class TPUJobController:
             self._pending_restart_counts[pod.metadata.key] = restarts + 1
         return False
 
+
+    def _export_capacity_gauges(self) -> None:
+        """Free whole-slice inventory per accelerator type, as gauges.
+        Cheap when nothing changed: the allocator's version counter
+        gates the O(types x boxes) recomputation off the hot reconcile
+        path (admit is called on every sync and is usually a no-op)."""
+        v = self.allocator.version
+        if v == getattr(self, "_gauges_version", None):
+            return
+        self._gauges_version = v
+        for acc, n in self.allocator.capacity_summary().items():
+            self.metrics.set_gauge(f"gang.free_slices.{acc}", float(n))
+
     def _record_evaluator_failure(self, key: str, pod: Pod) -> None:
         """Once-per-pod-uid event: the terminally-Failed evaluator pod is
         kept around, so every subsequent reconcile re-observes it — without
-        dedup the event log floods."""
-        if pod.metadata.uid in self._evaluator_failures_seen:
+        dedup the event log floods. Keyed by job so deletion can prune."""
+        entry = (key, pod.metadata.uid)
+        if entry in self._evaluator_failures_seen:
             return
-        self._evaluator_failures_seen.add(pod.metadata.uid)
+        self._evaluator_failures_seen.add(entry)
         self.recorder.event("TPUJob", key, "EvaluatorFailed", pod.metadata.name)
+
+    def _prune_evaluator_failures(self, key: str) -> None:
+        """Drop all controller-side memory for a deleted job (evaluator
+        failure dedup + gang-restart floor)."""
+        self._evaluator_failures_seen = {
+            e for e in self._evaluator_failures_seen if e[0] != key
+        }
+        self._gang_restarts_floor.pop(key, None)
 
     def _delete_pod(self, ns: str, name: str) -> None:
         try:
@@ -472,6 +511,14 @@ class TPUJobController:
 
         n_active = sum(rs.active for rs in new_statuses.values())
         n_expected = helpers.total_replicas(job)
+        # Permanently-failed evaluators (left in place by design — see
+        # _handle_failures) must not block the Running transition or the
+        # start_time stamp active_deadline_seconds hangs off.
+        n_dead_evaluators = sum(
+            1 for p in observed
+            if p.status.phase == PodPhase.FAILED
+            and p.metadata.labels.get(L.REPLICA_TYPE) == ReplicaType.EVALUATOR.value
+        )
 
         if done:
             if helpers.set_condition(
@@ -482,10 +529,16 @@ class TPUJobController:
                 self.metrics.inc("tpujob.succeeded")
                 changed = True
             self.allocator.release(job.metadata.uid)
-        elif n_active == n_expected and n_expected > 0:
+            self._export_capacity_gauges()
+        elif n_active == n_expected - n_dead_evaluators and n_active > 0:
             running = all(
                 p.status.phase == PodPhase.RUNNING for p in observed
                 if p.metadata.labels.get(L.REPLICA_TYPE)
+                and not (
+                    p.status.phase == PodPhase.FAILED
+                    and p.metadata.labels.get(L.REPLICA_TYPE)
+                    == ReplicaType.EVALUATOR.value
+                )
             )
             if running:
                 if job.status.start_time is None:
@@ -520,6 +573,7 @@ class TPUJobController:
         """Clean-pod policy + TTL for finished jobs; slices are returned to
         the pool either way."""
         self.allocator.release(job.metadata.uid)
+        self._export_capacity_gauges()
         policy = job.spec.run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
         if policy == CleanPodPolicy.ALL:
             self._delete_job_pods(job, only_phases=None)
@@ -555,6 +609,8 @@ class TPUJobController:
         self._delete_job_pods(job, only_phases=None)
         self._delete_job_services(job)
         self.allocator.release(job.metadata.uid)
+        self._export_capacity_gauges()
+        self._prune_evaluator_failures(key)
         if FINALIZER in job.metadata.finalizers:
             job.metadata.finalizers.remove(FINALIZER)
             try:
