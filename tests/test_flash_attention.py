@@ -143,10 +143,13 @@ def test_causal_lq_gt_lk_rejected():
         flash_attention(q, k[:, :32], v[:, :32], causal=True)
 
 
-def test_mask_rejected():
+def test_key_mask_all_valid_equals_unmasked():
     q, k, v = _qkv(l=32)
-    with pytest.raises(NotImplementedError):
-        flash_attention(q, k, v, mask=jnp.ones((2, 32), bool))
+    got = flash_attention(
+        q, k, v, mask=jnp.ones((2, 32), bool), block_q=16, block_k=16
+    )
+    want = flash_attention(q, k, v, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
 def test_under_jit():
@@ -154,3 +157,108 @@ def test_under_jit():
     got = jax.jit(lambda a, b, c: flash_attention(a, b, c, block_q=32, block_k=32))(q, k, v)
     want = dot_product_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def _key_mask(b, lk, lengths, dtype=bool):
+    m = np.zeros((b, lk), dtype)
+    for i, n in enumerate(lengths):
+        m[i, :n] = True
+    return jnp.asarray(m)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padding_mask_matches_reference(causal):
+    q, k, v = _qkv(l=128)
+    mask = _key_mask(2, 128, [128, 96])
+    got = flash_attention(q, k, v, mask=mask, causal=causal, block_q=32, block_k=32)
+    want = dot_product_attention(q, k, v, mask=mask, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_padding_mask_gradients_match_reference():
+    q, k, v = _qkv(l=64, d=8, seed=11)
+    mask = _key_mask(2, 64, [64, 40])
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, mask=mask, block_q=16, block_k=16) ** 2
+        )
+
+    def f_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, mask=mask) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fully_masked_batch_row_is_finite():
+    """A batch element whose keys are ALL masked must yield zero output
+    and zero (finite) grads — not exp-overflow NaNs."""
+    q, k, v = _qkv(l=32, d=8)
+    mask = _key_mask(2, 32, [32, 0])  # second batch element fully masked
+
+    out = flash_attention(q, k, v, mask=mask, block_q=16, block_k=16)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out[1]), 0.0, atol=1e-6)
+
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, mask=mask, block_q=16, block_k=16) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a)).all()
+    np.testing.assert_allclose(np.asarray(g[0][1]), 0.0, atol=1e-6)
+
+
+def test_cross_attention_with_mask():
+    """Encoder-decoder shape: lq != lk plus key padding (the T5 cross-
+    attention case)."""
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.standard_normal((2, 32, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 64, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 64, 2, 8)), jnp.float32)
+    mask = _key_mask(2, 64, [64, 48])
+    got = flash_attention(q, k, v, mask=mask, block_q=16, block_k=16)
+    want = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_3d_mask_rejected():
+    q, k, v = _qkv(l=32)
+    with pytest.raises(NotImplementedError):
+        flash_attention(q, k, v, mask=jnp.ones((2, 32, 32), bool))
+
+
+def test_left_padded_causal_mask_does_not_leak_future():
+    """Review-found corner case: with causal=True and a LEFT-padded key
+    mask, a row whose causally visible keys are all masked must output
+    zero — not a uniform average over causally-forbidden future keys
+    (exp(_NEG - _NEG) = 1 resurrection)."""
+    q, k, v = _qkv(l=32, d=8)
+    mask = jnp.asarray(
+        np.concatenate([np.zeros((2, 8), bool), np.ones((2, 24), bool)], 1)
+    )
+    out = flash_attention(q, k, v, mask=mask, causal=True, block_q=16, block_k=16)
+    out = np.asarray(out)
+    assert np.isfinite(out).all()
+    # rows 0..7: every causally visible key (0..row) is masked -> zero
+    np.testing.assert_allclose(out[:, :8], 0.0, atol=1e-6)
+    # visible rows must match the reference exactly
+    want = np.asarray(dot_product_attention(q, k, v, mask=mask, causal=True))
+    np.testing.assert_allclose(out[:, 8:], want[:, 8:], atol=1e-5)
+
+    # gradients: nothing may flow to/through the fully-masked rows
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, mask=mask, causal=True,
+                            block_q=16, block_k=16).astype(jnp.float32) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a)).all()
+    np.testing.assert_allclose(np.asarray(g[0][:, :8]), 0.0, atol=1e-6)  # dq pad rows

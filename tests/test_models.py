@@ -202,3 +202,29 @@ class TestDLRM:
             jax.random.key(0),
         )
         assert np.isfinite(float(metrics["loss"]))
+
+
+def test_t5_flash_attention_matches_xla_path():
+    """T5 with the mask-capable flash kernel (task_for_mesh's TPU
+    selection) computes the same loss as the XLA attention path — the
+    padding-mask cross-attention included."""
+    import numpy as np
+
+    from tfk8s_tpu.models import t5
+    from tfk8s_tpu.ops.flash_attention import flash_attention
+
+    cfg = t5.tiny_config()
+    base = t5.make_task(cfg=cfg, seq_len=32, batch_size=4)
+    flash = t5.make_task(cfg=cfg, seq_len=32, batch_size=4,
+                         attn_fn=lambda q, k, v, mask=None, causal=False:
+                         flash_attention(q, k, v, mask=mask, causal=causal,
+                                         block_q=16, block_k=16))
+    rng = jax.random.key(0)
+    params = base.init(rng)
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    params = unbox(params)
+    batch = base.make_batch(np.random.default_rng(0), 4)
+    l1, _ = base.loss_fn(params, batch, rng)
+    l2, _ = flash.loss_fn(params, batch, rng)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=2e-3)

@@ -177,12 +177,7 @@ def task_for_mesh(
     # NB: the ops package re-exports the flash_attention *function*,
     # shadowing the submodule attribute — import symbols from the
     # submodule directly.
-    from tfk8s_tpu.ops.flash_attention import (
-        DEFAULT_BLOCK_Q,
-        FLASH_SEQ_THRESHOLD,
-        _on_tpu,
-        flash_attention,
-    )
+    from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
 
     cfg = cfg or base_config()
     seq_sharded = (
@@ -194,16 +189,10 @@ def task_for_mesh(
     # default block_q). Explicit cfg.attention_impl == "flash" trusts
     # the caller's block sizes.
     seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
-    attn_fn = None
     if cfg.attention_impl == "ring" or seq_sharded:
         attn_fn = make_ring_attn_fn(mesh)
-    elif cfg.attention_impl == "flash" or (
-        cfg.attention_impl == "full"
-        and _on_tpu()
-        and seq_len >= FLASH_SEQ_THRESHOLD
-        and seq_len % DEFAULT_BLOCK_Q == 0
-    ):
-        attn_fn = flash_attention
+    else:
+        attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 

@@ -49,6 +49,7 @@ class T5(nn.Module):
     """Encoder-decoder with a shared embedding table and tied head."""
 
     cfg: TransformerConfig
+    attn_fn: Optional[Any] = None  # e.g. ops.flash_attention (mask-capable)
 
     def setup(self):
         cfg = self.cfg
@@ -56,10 +57,18 @@ class T5(nn.Module):
         enc_layer = maybe_remat(EncoderLayer, cfg)
         dec_layer = maybe_remat(DecoderLayer, cfg)
         self.enc_layers = [
-            enc_layer(cfg, use_moe=cfg.layer_uses_moe(i), name=f"enc{i}")
+            enc_layer(
+                cfg,
+                attn_fn=self.attn_fn,
+                use_moe=cfg.layer_uses_moe(i),
+                name=f"enc{i}",
+            )
             for i in range(cfg.num_layers)
         ]
-        self.dec_layers = [dec_layer(cfg, name=f"dec{i}") for i in range(cfg.num_layers)]
+        self.dec_layers = [
+            dec_layer(cfg, attn_fn=self.attn_fn, name=f"dec{i}")
+            for i in range(cfg.num_layers)
+        ]
         self.enc_ln = _ln("enc_ln")
         self.dec_ln = _ln("dec_ln")
 
@@ -122,10 +131,11 @@ def make_task(
     seq_len: int = 128,
     batch_size: int = 32,
     targets: Optional[Dict[str, float]] = None,
+    attn_fn: Optional[Any] = None,
 ) -> TrainTask:
     cfg = cfg or base_config()
     seq_len = min(seq_len, cfg.max_len)
-    model = T5(cfg)
+    model = T5(cfg, attn_fn=attn_fn)
 
     def init(rng):
         z = jnp.zeros((1, seq_len), jnp.int32)
@@ -160,6 +170,25 @@ def make_task(
     )
 
 
+def task_for_mesh(
+    mesh,
+    cfg: Optional[TransformerConfig] = None,
+    **task_kw,
+) -> TrainTask:
+    """Pick the attention impl for the mesh/config: the Pallas flash
+    kernel (mask-capable — the decoder's key-padding cross-attention
+    rides the [batch, lk] validity form, ops/flash_attention.py) on TPU
+    once the sequence crosses FLASH_SEQ_THRESHOLD. Unlike BERT's
+    task_for_mesh, no ring-attention branch: the ring kernel has no mask
+    support and T5's enc-dec attention is mask-carrying throughout."""
+    from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
+
+    cfg = cfg or base_config()
+    seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
+    attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
+    return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
+
+
 def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     """TPUJob entrypoint: ``tfk8s_tpu.models.t5:train``. MoE (EP) in the
     encoder is job-configurable via ``TFK8S_NUM_EXPERTS``."""
@@ -172,4 +201,10 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
         num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
         moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
     )
-    run_task(make_task(cfg=cfg, seq_len=seq, batch_size=batch), env, stop)
+    from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
+
+    ctx = ProcessContext.from_env(env)
+    initialize_distributed(ctx, env)
+    mesh = build_mesh(ctx)
+    task = task_for_mesh(mesh, cfg=cfg, seq_len=seq, batch_size=batch)
+    run_task(task, env, stop, mesh=mesh)

@@ -198,6 +198,7 @@ class DecoderLayer(nn.Module):
     """Causal self-attention + cross-attention + MLP (T5-style decoder)."""
 
     cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -208,9 +209,13 @@ class DecoderLayer(nn.Module):
     ) -> jax.Array:
         cfg = self.cfg
         h = _ln("ln_self")(x).astype(cfg.dtype)
-        x = x + MultiHeadAttention(cfg, causal=True, name="self_attn")(h)
+        x = x + MultiHeadAttention(
+            cfg, causal=True, attn_fn=self.attn_fn, name="self_attn"
+        )(h)
         h = _ln("ln_cross")(x).astype(cfg.dtype)
-        x = x + MultiHeadAttention(cfg, name="cross_attn")(h, kv=enc, mask=enc_mask)
+        x = x + MultiHeadAttention(cfg, attn_fn=self.attn_fn, name="cross_attn")(
+            h, kv=enc, mask=enc_mask
+        )
         h = _ln("ln_mlp")(x).astype(cfg.dtype)
         return x + MlpBlock(cfg, name="mlp")(h)
 

@@ -61,7 +61,17 @@ _LSE_LANES = 128
 # -- forward -----------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causal: bool):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool, has_mask: bool = False
+):
+    # positional refs: [mask,] o [, lse] — mask is an optional INPUT so it
+    # precedes the outputs in pallas_call's ref order
+    if has_mask:
+        mask_ref, *outs = rest
+    else:
+        mask_ref, outs = None, list(rest)
+    o_ref = outs[0]
+    lse_ref = outs[1] if len(outs) > 1 else None
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [BQ, d]
     block_q = q.shape[0]
@@ -93,9 +103,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causa
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG)
+        if mask_ref is not None:
+            valid = mask_ref[0, :, pl.ds(kb * block_k, block_k)] > 0  # [1, BK]
+            if causal:
+                # fold causality into the zeroed set: when a row's running
+                # max is still _NEG (all visible keys masked so far),
+                # exp(_NEG - _NEG) = 1 would resurrect causally-forbidden
+                # entries too — the explicit zeroing must cover them
+                valid = valid & (q_pos >= k_pos)
+            s = jnp.where(valid, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
+        if mask_ref is not None:
+            # a fully-masked first block would give exp(_NEG - _NEG) = 1:
+            # zero masked entries explicitly (exact, not just numerical)
+            p = jnp.where(valid, p, 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jnp.dot(
             p, vblk, preferred_element_type=jnp.float32
@@ -123,9 +146,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causa
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-    *, block_k: int, causal: bool,
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
+    block_k: int, causal: bool, has_mask: bool = False,
 ):
+    if has_mask:
+        mask_ref, dq_ref = rest
+    else:
+        mask_ref, dq_ref = None, rest[0]
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [BQ, d]
     do = do_ref[0].astype(jnp.float32)
@@ -153,6 +180,13 @@ def _bwd_dq_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         p = jnp.exp(s - lse)  # masked entries: exp(-inf) = 0
+        if mask_ref is not None:
+            # fully-masked rows have a degenerate lse; zero explicitly —
+            # including causally-forbidden entries (see _fwd_kernel)
+            valid = mask_ref[0, :, pl.ds(kb * block_k, block_k)] > 0
+            if causal:
+                valid = valid & (q_pos >= k_pos)
+            p = jnp.where(valid, p, 0.0)
         dp = jnp.dot(do, vblk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec)
         return acc + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
@@ -169,9 +203,13 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
-    *, block_q: int, causal: bool,
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
+    block_q: int, causal: bool, has_mask: bool = False,
 ):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref = rest
+    else:
+        mask_ref, (dk_ref, dv_ref) = None, rest
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)  # [BK, d]
     v = v_ref[0].astype(jnp.float32)
@@ -199,6 +237,13 @@ def _bwd_dkv_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         p = jnp.exp(s - lse)
+        if mask_ref is not None:
+            valid = mask_ref[0, :, pl.ds(ki * block_k, block_k)] > 0  # [1, BK]
+            if causal:
+                # cover causally-forbidden entries resurrected by a
+                # degenerate lse (see _fwd_kernel)
+                valid = valid & (q_pos >= k_pos)
+            p = jnp.where(valid, p, 0.0)
         dv_new = dv + jnp.dot(p.T, doblk, preferred_element_type=jnp.float32)
         dp = jnp.dot(doblk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dvec)
@@ -247,7 +292,21 @@ def _heads_minor(x, b, h):
     return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret, save_lse=True):
+def _mask_operand(mask, b, h, lk):
+    """[b, lk] bool/int key-validity -> ([b, 1, lk] f32 operand, in_spec).
+    The singleton middle dim makes the block's last-two dims (1, lk) —
+    legal because dim -2 equals the array dim (Mosaic tiling rule)."""
+    m = jnp.asarray(mask)
+    assert m.shape == (b, lk), (
+        f"mask must be [batch, lk] key validity, got {m.shape} for "
+        f"batch={b}, lk={lk}"
+    )
+    operand = m.astype(jnp.float32).reshape(b, 1, lk)
+    spec = pl.BlockSpec((1, 1, lk), lambda i, j: (i // h, 0, 0))
+    return operand, spec
+
+
+def _flash_fwd_impl(q, k, v, mask, causal, block_q, block_k, interpret, save_lse=True):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq = min(block_q, lq)
@@ -256,6 +315,17 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret, save_lse=True)
         f"seq lens ({lq}, {lk}) must divide block sizes ({bq}, {bk})"
     )
     qr, kr, vr = _heads_major(q), _heads_major(k), _heads_major(v)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if mask is not None:
+        m_op, m_spec = _mask_operand(mask, b, h, lk)
+        operands.append(m_op)
+        in_specs.append(m_spec)
 
     out_shape = [jax.ShapeDtypeStruct((b * h, lq, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))]
@@ -267,22 +337,20 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret, save_lse=True)
             pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j: (i, j, 0))
         )
     res = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=bk, causal=causal),
+        functools.partial(
+            _fwd_kernel, block_k=bk, causal=causal, has_mask=mask is not None
+        ),
         out_shape=tuple(out_shape),
         grid=(b * h, lq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, lk, d), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=tuple(out_specs),
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*operands)
     out, lse = res if save_lse else (res[0], None)
     return _heads_minor(out, b, h), lse
 
 
-def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+def _flash_bwd_impl(q, k, v, mask, o, lse, g, causal, block_q, block_k, interpret):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq = min(block_q, lq)
@@ -290,8 +358,15 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
     qr, kr, vr = _heads_major(q), _heads_major(k), _heads_major(v)
     dor, orr = _heads_major(g), _heads_major(o)
 
+    mask_ops, mask_specs = [], []
+    if mask is not None:
+        m_op, m_spec = _mask_operand(mask, b, h, lk)
+        mask_ops, mask_specs = [m_op], [m_spec]
+
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal),
+        functools.partial(
+            _bwd_dq_kernel, block_k=bk, causal=causal, has_mask=mask is not None
+        ),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         grid=(b * h, lq // bq),
         in_specs=[
@@ -301,13 +376,15 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),  # do
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),  # o
             pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j: (i, j, 0)),  # lse
-        ],
+        ] + mask_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         interpret=interpret,
-    )(qr, kr, vr, dor, orr, lse)
+    )(qr, kr, vr, dor, orr, lse, *mask_ops)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal),
+        functools.partial(
+            _bwd_dkv_kernel, block_q=bq, causal=causal, has_mask=mask is not None
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, lk, d), v.dtype),
@@ -320,13 +397,13 @@ def _flash_bwd_impl(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, lq, d), lambda i, j: (i, 0, 0)),  # do
             pl.BlockSpec((1, lq, d), lambda i, j: (i, 0, 0)),  # o
             pl.BlockSpec((1, lq, _LSE_LANES), lambda i, j: (i, 0, 0)),  # lse
-        ],
+        ] + mask_specs,
         out_specs=(
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
         ),
         interpret=interpret,
-    )(qr, kr, vr, dor, orr, lse)
+    )(qr, kr, vr, dor, orr, lse, *mask_ops)
 
     return (
         _heads_minor(dq, b, h),
@@ -340,27 +417,48 @@ def _on_tpu() -> bool:
     return plat in ("tpu", "axon")
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
+def auto_flash_attn_fn(attention_impl: str, seq_len: int):
+    """THE flash auto-selection policy, shared by every model family's
+    ``task_for_mesh``: explicit ``attention_impl == "flash"`` always wins;
+    the default ("full") upgrades to flash on TPU once the sequence
+    crosses FLASH_SEQ_THRESHOLD and divides the default q block. Returns
+    ``flash_attention`` or None (= use the XLA path)."""
+    if attention_impl == "flash":
+        return flash_attention
+    if (
+        attention_impl == "full"
+        and _on_tpu()
+        and seq_len >= FLASH_SEQ_THRESHOLD
+        and seq_len % DEFAULT_BLOCK_Q == 0
+    ):
+        return flash_attention
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, causal, block_q, block_k):
     # Primal (inference) path: skip the lse store entirely — pallas
     # outputs aren't DCE'd by XLA, and the (b*h, lq, 128) f32 residual
     # is 4x the bytes of the bf16 output itself.
     out, _ = _flash_fwd_impl(
-        q, k, v, causal, block_q, block_k, not _on_tpu(), save_lse=False
+        q, k, v, mask, causal, block_q, block_k, not _on_tpu(), save_lse=False
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, not _on_tpu())
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, mask, causal, block_q, block_k):
+    out, lse = _flash_fwd_impl(
+        q, k, v, mask, causal, block_q, block_k, not _on_tpu()
+    )
+    return out, (q, k, v, mask, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    return _flash_bwd_impl(
-        q, k, v, o, lse, g, causal, block_q, block_k, not _on_tpu()
+    q, k, v, mask, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, mask, o, lse, g, causal, block_q, block_k, not _on_tpu()
     )
+    return dq, dk, dv, None  # mask is non-differentiable
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -375,13 +473,16 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
-    """Drop-in for models.transformer.dot_product_attention (padding
-    masks unsupported — pretraining data here is unpadded). Forward AND
-    backward run as Pallas kernels; grads agree with the XLA reference
-    to 1e-2 in bf16 (tests/test_flash_attention.py)."""
-    if mask is not None:
+    """Drop-in for models.transformer.dot_product_attention. ``mask`` is
+    the 2-D ``[batch, lk]`` key-validity form (True = attend); rows whose
+    keys are ALL masked produce zero output and zero grads (the XLA
+    reference returns a uniform average there — a degenerate case no real
+    config hits). Forward AND backward run as Pallas kernels; grads agree
+    with the XLA reference to 1e-2 in bf16 (tests/test_flash_attention.py)."""
+    if mask is not None and jnp.ndim(mask) != 2:
         raise NotImplementedError(
-            "flash attention: padding masks not supported; pass mask=None"
+            "flash attention: only [batch, lk] key-validity masks are "
+            f"supported, got shape {jnp.shape(mask)}"
         )
     if causal and q.shape[1] > k.shape[1]:
         # lq > lk leaves some query rows with zero visible keys, where the
@@ -391,4 +492,4 @@ def flash_attention(
             f"causal flash attention requires lq <= lk, got lq={q.shape[1]} "
             f"lk={k.shape[1]}"
         )
-    return _flash(q, k, v, causal, block_q, block_k)
+    return _flash(q, k, v, mask, causal, block_q, block_k)
