@@ -138,7 +138,56 @@ def _flash_speedup(seq: int = 2048, iters: int = 8):
     return time_one(flash_attention), time_one(dot_product_attention)
 
 
+_PROBE_CODE = """
+import os
+if os.environ.get("BENCH_PLATFORM"):
+    from tfk8s_tpu.runtime.launcher import force_platform
+    force_platform(os.environ["BENCH_PLATFORM"])
+import jax
+jax.devices()
+"""
+
+
+def _probe_backend(timeout_s: float) -> None:
+    """Fail FAST (rc=1 with a reason) when the accelerator backend is
+    unreachable — jax.devices() can hang indefinitely when the remote
+    tunnel is down, which would wedge the driver instead of reporting."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # APPEND to any existing PYTHONPATH — on this rig it carries the
+    # remote-TPU plugin's sitecustomize; clobbering it would probe a
+    # different backend than the bench uses.
+    pp = os.environ.get("PYTHONPATH", "")
+    pp = f"{repo}{os.pathsep}{pp}" if pp else repo
+    try:
+        subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            timeout=timeout_s,
+            check=True,
+            capture_output=True,
+            env={**os.environ, "PYTHONPATH": pp},
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"bench: accelerator backend unreachable (probe timed out "
+            f"after {timeout_s:.0f}s — remote tunnel down?)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    except subprocess.CalledProcessError as exc:
+        print(
+            "bench: backend init failed:\n"
+            + exc.stderr.decode(errors="replace")[-2000:],
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+
 def main() -> None:
+    # CPU runs can't hang on a dead tunnel — skip the (double-init) probe
+    if os.environ.get("BENCH_PLATFORM") != "cpu":
+        _probe_backend(float(os.environ.get("BENCH_PROBE_TIMEOUT", "300")))
     if os.environ.get("BENCH_PLATFORM"):
         # e.g. BENCH_PLATFORM=cpu for the hermetic smoke test — env vars
         # alone don't switch platforms here (sitecustomize imports jax at
