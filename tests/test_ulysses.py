@@ -1,0 +1,180 @@
+"""Ulysses (head all-to-all) sequence parallelism correctness: exact
+match against the full-attention reference on a sequence-sharded mesh
+(SURVEY.md §2 'Ulysses' row). Runs on the 8-virtual-device CPU backend."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfk8s_tpu.models.transformer import dot_product_attention
+from tfk8s_tpu.parallel.mesh import make_mesh
+from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn
+
+
+def _qkv(b=2, l=32, h=4, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(causal):
+    mesh = make_mesh(sequence=4)
+    q, k, v = _qkv()
+    uly = make_ulysses_attn_fn(mesh)
+    got = uly(q, k, v, causal=causal)
+    want = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_key_padding_mask_matches_full_attention():
+    """The capability ring attention lacks: a global [b, lk] validity
+    mask applies unchanged because each device sees the full key axis."""
+    mesh = make_mesh(sequence=4)
+    q, k, v = _qkv()
+    mask = jnp.asarray(
+        np.random.default_rng(1).random((2, 32)) > 0.3, bool
+    ).at[:, 0].set(True)  # keep at least one valid key per row
+    uly = make_ulysses_attn_fn(mesh)
+    got = uly(q, k, v, mask=mask)
+    want = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_with_batch_and_tensor_axes():
+    # sequence parallel composed with dp + tp on one mesh; heads split
+    # over tensor first, then over sequence inside the shard
+    mesh = make_mesh(data=2, sequence=2, tensor=2)
+    q, k, v = _qkv(b=4, l=16, h=4, d=8)
+    uly = make_ulysses_attn_fn(mesh)
+    got = uly(q, k, v, causal=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_under_jit_and_grads():
+    mesh = make_mesh(sequence=4)
+    q, k, v = _qkv(h=8)
+    uly = make_ulysses_attn_fn(mesh)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True) ** 2)
+
+    g_got = jax.jit(jax.grad(lambda *a: loss(uly, *a), argnums=(0, 1, 2)))(q, k, v)
+    g_want = jax.grad(lambda *a: loss(dot_product_attention, *a), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_head_count_guard():
+    """Sequence degree beyond the per-device head count must fail loudly
+    (the recipe says: use ring attention there)."""
+    mesh = make_mesh(sequence=8)
+    q, k, v = _qkv(h=4)  # 4 heads < sequence=8
+    uly = make_ulysses_attn_fn(mesh)
+    with pytest.raises(ValueError, match="ring attention"):
+        uly(q, k, v)
+
+
+def test_full_qk_mask_rejected():
+    mesh = make_mesh(sequence=4)
+    q, k, v = _qkv()
+    uly = make_ulysses_attn_fn(mesh)
+    with pytest.raises(NotImplementedError):
+        uly(q, k, v, mask=jnp.ones((2, 32, 32), bool))
+
+
+def test_bert_task_for_mesh_prefers_ulysses_within_head_count():
+    """Auto-selection on a sequence-sharded mesh: Ulysses while the
+    sequence degree divides the per-device head count, ring beyond."""
+    from tfk8s_tpu.models import bert
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    mesh = make_mesh(data=2, sequence=4)
+    cfg = bert.tiny_config()  # 4 heads -> sequence=4 fits Ulysses
+    task = bert.task_for_mesh(mesh, cfg=cfg, seq_len=32, batch_size=8)
+    trainer = Trainer(task, TrainConfig(steps=2, learning_rate=1e-3), mesh)
+    _, history = trainer.fit()
+    assert np.isfinite(history[-1]["loss"])
+
+    # same loss as the unsharded reference on identical params/batch
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    t_full = bert.make_task(cfg=cfg, seq_len=32, batch_size=8)
+    p = unbox(t_full.init(jax.random.key(0)))
+    batch = t_full.make_batch(np.random.default_rng(0), 8)
+    l_full, _ = t_full.loss_fn(p, batch, jax.random.key(1))
+    l_uly, _ = task.loss_fn(p, batch, jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(l_full), np.asarray(l_uly), atol=2e-2)
+
+    # sequence=8 > 4 heads -> auto-selection falls back to ring
+    mesh8 = make_mesh(sequence=8)
+    t8 = bert.task_for_mesh(mesh8, cfg=cfg, seq_len=32, batch_size=8)
+    tr8 = Trainer(t8, TrainConfig(steps=1), mesh8)
+    _, h8 = tr8.fit()
+    assert np.isfinite(h8[-1]["loss"])
+
+
+def test_impl_selection_policy_errors():
+    """Explicit pins are honored or rejected loudly — never silently
+    substituted (code-review findings, round 2)."""
+    from tfk8s_tpu.models import bert, t5
+    from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
+
+    seq_mesh = make_mesh(data=2, sequence=2)
+    flat_mesh = make_mesh(data=4)
+
+    # typo'd impl raises instead of silently running XLA attention
+    with pytest.raises(ValueError, match="unknown attention_impl"):
+        auto_flash_attn_fn("flsh", 2048)
+
+    # pinned full/flash on a sequence-sharded mesh: refuse, don't swap
+    with pytest.raises(ValueError, match="sequence-sharded"):
+        bert.task_for_mesh(
+            seq_mesh, cfg=bert.tiny_config(attention_impl="flash"),
+            seq_len=32, batch_size=8,
+        )
+    with pytest.raises(ValueError, match="sequence-sharded"):
+        t5.task_for_mesh(
+            seq_mesh, cfg=t5.tiny_config(attention_impl="full"),
+            seq_len=16, batch_size=8,
+        )
+
+    # T5 cannot run ring (no mask support) — explicit pin must say so
+    with pytest.raises(ValueError, match="mask"):
+        t5.task_for_mesh(
+            flat_mesh, cfg=t5.tiny_config(attention_impl="ring"),
+            seq_len=16, batch_size=8,
+        )
+
+    # ulysses pinned on a mesh without a sequence axis: actionable error
+    with pytest.raises(ValueError, match="sequence=N"):
+        bert.task_for_mesh(
+            flat_mesh, cfg=bert.tiny_config(attention_impl="ulysses"),
+            seq_len=32, batch_size=8,
+        )
+
+    # T5 has no ring fallback, so a sequence degree beyond its head
+    # count must fail at task CONSTRUCTION with T5-appropriate advice
+    # (not at trace time with 'use ring attention')
+    with pytest.raises(ValueError, match="num_heads"):
+        t5.task_for_mesh(
+            make_mesh(sequence=8),  # tiny T5 has 4 heads
+            cfg=t5.tiny_config(), seq_len=16, batch_size=8,
+        )
+
+
+def test_t5_task_for_mesh_ulysses_trains():
+    """T5 long-context now has an SP path (Ulysses carries the decoder's
+    key-padding masks; ring could not)."""
+    from tfk8s_tpu.models import t5
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    mesh = make_mesh(data=2, sequence=2)
+    task = t5.task_for_mesh(mesh, cfg=t5.tiny_config(), seq_len=16, batch_size=8)
+    trainer = Trainer(task, TrainConfig(steps=3, learning_rate=1e-3), mesh)
+    _, history = trainer.fit()
+    assert np.isfinite(history[-1]["loss"])

@@ -166,14 +166,18 @@ def task_for_mesh(
     cfg: Optional[TransformerConfig] = None,
     **task_kw,
 ) -> TrainTask:
-    """Build the task with the attention impl the mesh calls for: ring
-    attention whenever the mesh has a nontrivial ``sequence`` axis (or
-    cfg.attention_impl == 'ring'); the pallas flash kernel when
+    """Build the task with the attention impl the mesh calls for. On a
+    sequence-sharded mesh: Ulysses head-all-to-all SP while the sequence
+    degree fits within the per-device head count, ring attention beyond
+    it (the long-context recipe — parallel/ulysses.py docstring); either
+    is also explicitly selectable via cfg.attention_impl ('ring' /
+    'ulysses'). Otherwise the pallas flash kernel when
     cfg.attention_impl == 'flash' — or by default on TPU once the
     sequence length crosses FLASH_SEQ_THRESHOLD (the XLA path's [L, L]
     scores buffer starts dominating HBM; flash's is O(L·d))."""
-    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
+    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE, AXIS_TENSOR
     from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
+    from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn
     # NB: the ops package re-exports the flash_attention *function*,
     # shadowing the submodule attribute — import symbols from the
     # submodule directly.
@@ -189,8 +193,24 @@ def task_for_mesh(
     # default block_q). Explicit cfg.attention_impl == "flash" trusts
     # the caller's block sizes.
     seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
-    if cfg.attention_impl == "ring" or seq_sharded:
+    if cfg.attention_impl == "ring":
         attn_fn = make_ring_attn_fn(mesh)
+    elif cfg.attention_impl == "ulysses":
+        attn_fn = make_ulysses_attn_fn(mesh)
+    elif seq_sharded:
+        if cfg.attention_impl != "auto":
+            # an explicit full/flash pin cannot serve a sequence-sharded
+            # mesh — refuse rather than silently substituting an SP impl
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} pinned on a "
+                "sequence-sharded mesh; sequence parallelism needs "
+                "'auto', 'ring', or 'ulysses'"
+            )
+        h_local = cfg.num_heads // mesh.shape.get(AXIS_TENSOR, 1)
+        if h_local % mesh.shape[AXIS_SEQUENCE] == 0:
+            attn_fn = make_ulysses_attn_fn(mesh)
+        else:
+            attn_fn = make_ring_attn_fn(mesh)
     else:
         attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
@@ -199,7 +219,10 @@ def task_for_mesh(
 def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     """TPUJob entrypoint: ``tfk8s_tpu.models.bert:train``. MoE (EP) is
     job-configurable: ``TFK8S_NUM_EXPERTS`` > 0 swaps every other MLP for
-    a SwitchMoeBlock sharded over the mesh's ``expert`` axis."""
+    a SwitchMoeBlock sharded over the mesh's ``expert`` axis.
+    ``TFK8S_MODEL_PRESET=tiny`` selects the test-scale config (hermetic
+    e2e jobs); ``TFK8S_ATTENTION_IMPL`` pins an attention impl
+    (full/flash/ring/ulysses) instead of the mesh-driven default."""
     from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
 
     env = dict(env)
@@ -207,9 +230,11 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
     seq = int(env.get("TFK8S_SEQ_LEN", "128"))
     batch = int(env.get("TFK8S_BATCH_SIZE", "64"))
-    cfg = base_config(
+    preset = tiny_config if env.get("TFK8S_MODEL_PRESET") == "tiny" else base_config
+    cfg = preset(
         num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
         moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
+        attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"),
     )
     ctx = ProcessContext.from_env(env)
     initialize_distributed(ctx, env)

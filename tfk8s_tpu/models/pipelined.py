@@ -102,7 +102,7 @@ def make_task(
         "BERT/T5 MoE path (TransformerConfig.num_experts) on a non-"
         "pipeline mesh"
     )
-    assert cfg.attention_impl == "full", (
+    assert cfg.attention_impl in ("auto", "full"), (
         f"pipelined family supports only full attention inside stages, "
         f"got {cfg.attention_impl!r}"
     )

@@ -138,7 +138,9 @@ def make_task(
     model = T5(cfg, attn_fn=attn_fn)
 
     def init(rng):
-        z = jnp.zeros((1, seq_len), jnp.int32)
+        # full batch shape: an SP attn_fn's shard_map needs the batch dim
+        # divisible by the data axis even at trace time (same as bert.py)
+        z = jnp.zeros((batch_size, seq_len), jnp.int32)
         return model.init(rng, z, z)["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
@@ -175,17 +177,53 @@ def task_for_mesh(
     cfg: Optional[TransformerConfig] = None,
     **task_kw,
 ) -> TrainTask:
-    """Pick the attention impl for the mesh/config: the Pallas flash
-    kernel (mask-capable — the decoder's key-padding cross-attention
-    rides the [batch, lk] validity form, ops/flash_attention.py) on TPU
-    once the sequence crosses FLASH_SEQ_THRESHOLD. Unlike BERT's
-    task_for_mesh, no ring-attention branch: the ring kernel has no mask
-    support and T5's enc-dec attention is mask-carrying throughout."""
+    """Pick the attention impl for the mesh/config. On a sequence-sharded
+    mesh: Ulysses head-all-to-all SP (parallel/ulysses.py) — unlike the
+    ring kernel it supports the [batch, lk] key-padding masks T5's
+    enc-dec attention carries throughout, so T5 long-context rides
+    Ulysses. Otherwise the Pallas flash kernel (also mask-capable,
+    ops/flash_attention.py) on TPU once the sequence crosses
+    FLASH_SEQ_THRESHOLD."""
     from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
+    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
+    from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn
 
     cfg = cfg or base_config()
     seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
-    attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
+    seq_sharded = (
+        AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
+    )
+    if cfg.attention_impl == "ring":
+        raise ValueError(
+            "attention_impl='ring' is not usable for T5: the ring kernel "
+            "carries no key-padding masks and T5's enc-dec attention is "
+            "mask-carrying throughout — use 'ulysses' (or 'auto')"
+        )
+    if cfg.attention_impl == "ulysses" or seq_sharded:
+        if seq_sharded and cfg.attention_impl not in ("auto", "ulysses"):
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} pinned on a "
+                "sequence-sharded mesh; T5 sequence parallelism needs "
+                "'auto' or 'ulysses'"
+            )
+        # Fail at task construction, not at trace time: T5 has no ring
+        # fallback (masks), so its Ulysses degree is hard-capped by the
+        # per-device head count — same check bert.task_for_mesh makes.
+        from tfk8s_tpu.parallel.mesh import AXIS_TENSOR
+
+        h_local = cfg.num_heads // mesh.shape.get(AXIS_TENSOR, 1)
+        sp = mesh.shape.get(AXIS_SEQUENCE, 1)
+        if sp > 1 and h_local % sp:
+            raise ValueError(
+                f"T5 sequence parallelism rides Ulysses head all-to-all, "
+                f"capped by heads: sequence={sp} does not divide the "
+                f"per-device head count {h_local} "
+                f"(= {cfg.num_heads} heads / tensor={mesh.shape.get(AXIS_TENSOR, 1)}); "
+                "lower the sequence degree or raise num_heads"
+            )
+        attn_fn = make_ulysses_attn_fn(mesh)
+    else:
+        attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 
@@ -197,9 +235,11 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
     seq = int(env.get("TFK8S_SEQ_LEN", "128"))
     batch = int(env.get("TFK8S_BATCH_SIZE", "32"))
-    cfg = base_config(
+    preset = tiny_config if env.get("TFK8S_MODEL_PRESET") == "tiny" else base_config
+    cfg = preset(
         num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
         moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
+        attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"),
     )
     from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
 

@@ -46,9 +46,12 @@ class TransformerConfig:
     dropout: float = 0.0  # keep 0 for determinism; hook exists
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
-    # 'full' | 'ring' — ring requires a mesh with a sequence axis and is
-    # injected by the task wrapper (models/bert.py etc.)
-    attention_impl: str = "full"
+    # 'auto' | 'full' | 'flash' | 'ring' | 'ulysses'. 'auto' (default)
+    # lets the task wrapper pick by mesh/hardware: an SP impl on a
+    # sequence-sharded mesh, the Pallas flash kernel on TPU at long
+    # sequence, XLA otherwise. Anything else is an explicit pin, honored
+    # or rejected loudly (never silently substituted) by task_for_mesh.
+    attention_impl: str = "auto"
     # Mixture-of-Experts (EP row, SURVEY.md §2): 0 = dense MLP everywhere;
     # >0 swaps the MLP of every ``moe_every``-th layer for a
     # SwitchMoeBlock with this many experts (parallel/moe.py), whose aux

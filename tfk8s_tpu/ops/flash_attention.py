@@ -419,15 +419,23 @@ def _on_tpu() -> bool:
 
 def auto_flash_attn_fn(attention_impl: str, seq_len: int):
     """THE flash auto-selection policy, shared by every model family's
-    ``task_for_mesh``: explicit ``attention_impl == "flash"`` always wins;
-    the default ("full") upgrades to flash on TPU once the sequence
-    crosses FLASH_SEQ_THRESHOLD and divides the default q block. Returns
-    ``flash_attention`` or None (= use the XLA path)."""
+    ``task_for_mesh``: explicit ``attention_impl == "flash"`` always
+    wins; ``"full"`` explicitly pins the XLA path; the default
+    (``"auto"``) upgrades to flash on TPU once the sequence crosses
+    FLASH_SEQ_THRESHOLD and divides the default q block. Returns
+    ``flash_attention`` or None (= use the XLA path). Unknown impl names
+    raise — a typo must not silently fall back to XLA attention."""
     if attention_impl == "flash":
         return flash_attention
+    if attention_impl == "full":
+        return None
+    if attention_impl != "auto":
+        raise ValueError(
+            f"unknown attention_impl {attention_impl!r}; expected one of "
+            "'auto', 'full', 'flash', 'ring', 'ulysses'"
+        )
     if (
-        attention_impl == "full"
-        and _on_tpu()
+        _on_tpu()
         and seq_len >= FLASH_SEQ_THRESHOLD
         and seq_len % DEFAULT_BLOCK_Q == 0
     ):

@@ -27,3 +27,4 @@ from tfk8s_tpu.parallel.pipeline import (  # noqa: F401
     stack_stage_params,
 )
 from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn  # noqa: F401
+from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn  # noqa: F401

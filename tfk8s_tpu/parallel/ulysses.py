@@ -1,0 +1,136 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: attention-head
+all-to-all context parallelism — the second SP strategy named by
+SURVEY.md §2 ("Ulysses (attention-head all-to-all) ... `all_to_all` over
+ICI mesh axis"), complementing ring attention (parallel/ring_attention.py).
+
+Mechanics: activations arrive sequence-sharded ([b, L/S, h, d] per
+device over the ``sequence`` axis). One ``lax.all_to_all`` trades the
+sequence sharding for a head sharding — every device then holds the FULL
+sequence for h/S of the heads ([b, L, h/S, d]) — so plain (unsharded)
+softmax attention runs locally with global causal/padding masks and zero
+per-step ring bookkeeping. A second all-to-all inverts the exchange.
+
+Trade-off vs ring attention (why both exist): Ulysses does 2 all-to-alls
+of O(L·h·d / S) per device regardless of ring size — cheaper than S-1
+ppermute hops when heads are plentiful and ICI all-to-all bandwidth is
+good (a TPU torus routes all-to-all well) — but its parallel degree is
+capped at the head count, while ring attention scales to any S and never
+materializes the full [L, L] score block. Long-context recipe: Ulysses
+while S <= heads, ring beyond.
+
+Unlike the ring path, key-padding masks are supported directly: the
+local attention sees the full key axis, so the global [b, L] mask applies
+unchanged (each device needs the whole mask — it is replicated over the
+sequence axis by its shard_map spec).
+
+The reference has no sequence-parallel story at all (SURVEY.md §2 SP
+rows: ABSENT; its only scaling axis is replica count, k8s-operator.md:6).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tfk8s_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+
+def _local_ulysses(
+    q: jax.Array,  # [b, L/S, h_local, d] pre-scaled
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],  # [b, L] key-validity, full length, or None
+    axis_name: str,
+    causal: bool,
+    inner: Callable,
+) -> jax.Array:
+    # seq-sharded -> head-sharded: [b, L/S, h, d] -> [b, L, h/S, d]
+    a2a = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    out = inner(a2a(q), a2a(k), a2a(v), mask=mask, causal=causal)
+    # head-sharded -> seq-sharded: [b, L, h/S, d] -> [b, L/S, h, d]
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def make_ulysses_attn_fn(
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQUENCE,
+    inner: Optional[Callable] = None,
+):
+    """Build an ``attn_fn(q, k, v, mask=None, causal=False)`` drop-in for
+    ``models/transformer.MultiHeadAttention``: batch over data(+fsdp),
+    heads over ``tensor``, sequence over ``seq_axis`` via head
+    all-to-all. ``inner`` is the per-device attention (default: the XLA
+    einsum path ``dot_product_attention``; pass a flash kernel to compose
+    Ulysses with Pallas attention). Requires the per-device head count to
+    be divisible by the sequence-axis size."""
+    if inner is None:
+        from tfk8s_tpu.models.transformer import dot_product_attention
+
+        inner = dot_product_attention
+
+    if seq_axis not in mesh.axis_names:
+        raise ValueError(
+            f"ulysses attention needs a {seq_axis!r} axis on the mesh; "
+            f"this mesh has {tuple(mesh.axis_names)} — add sequence=N to "
+            "the job's MeshSpec (or drop the explicit 'ulysses' pin)"
+        )
+    batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
+    head_axis = AXIS_TENSOR if AXIS_TENSOR in mesh.axis_names else None
+    bspec = batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None)
+    spec = P(bspec, seq_axis, head_axis, None)
+    sp = mesh.shape[seq_axis]
+    tp = mesh.shape[AXIS_TENSOR] if head_axis else 1
+
+    def attn_fn(q, k, v, mask=None, causal=False):
+        if mask is not None and mask.ndim != 2:
+            raise NotImplementedError(
+                "ulysses attention: only 2-D [batch, key_len] key-padding "
+                "masks are supported (full [q, k] masks would need "
+                f"sequence-sharded rows); got mask.ndim={mask.ndim}"
+            )
+        h_local = q.shape[2] // tp
+        if h_local % sp:
+            raise ValueError(
+                f"ulysses attention: per-device head count {h_local} "
+                f"(= {q.shape[2]} heads / tensor={tp}) is not divisible by "
+                f"sequence={sp}; use ring attention beyond the head count "
+                "(parallel/ring_attention.py)"
+            )
+        body = functools.partial(
+            _local_ulysses, axis_name=seq_axis, causal=causal, inner=inner
+        )
+        if mask is None:
+            inner_sm = shard_map(
+                lambda a, b, c: body(a, b, c, None),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            return inner_sm(q, k, v)
+        # the mask's key axis must stay FULL on every device (local
+        # attention sees all keys), so its spec replicates over seq_axis
+        inner_sm = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, P(bspec, None)),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return inner_sm(q, k, v, mask)
+
+    return attn_fn
