@@ -1,0 +1,192 @@
+"""Pod log capture -> status.log_tail -> `logs` CLI verb (`kubectl logs`
+parity). The reference world reads training logs with kubectl
+(k8s-operator.md:50-52 shows the kubectl workflow); here the kubelet
+captures each pod thread's tfk8s.* log records into a bounded tail that
+rides PodStatus — readable by any client, including across the remote
+apiserver, with a plain GET."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodPhase,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    helpers,
+)
+from tfk8s_tpu.api.types import CleanPodPolicy, RunPolicy, SchedulingPolicy
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer import labels as L
+from tfk8s_tpu.utils.logging import get_logger
+
+from conftest import wait_for
+
+tlog = get_logger("test-entrypoint")
+
+
+@registry.register("logs.chatty")
+def _chatty(env):
+    for i in range(5):
+        tlog.info("chatty line %d", i)
+
+
+@registry.register("logs.slow-chatty")
+def _slow_chatty(env, stop):
+    tlog.info("started")
+    stop.wait(8)  # keep running until torn down; mid-run flush must show it
+
+
+@registry.register("logs.failing")
+def _failing(env):
+    tlog.info("about to fail")
+    raise RuntimeError("deliberate")
+
+
+@pytest.fixture
+def cluster():
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-1": 4}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, stop
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def make_job(name, entrypoint, policy=CleanPodPolicy.NONE):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint=entrypoint)
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+            run_policy=RunPolicy(
+                scheduling=SchedulingPolicy(gang=True), clean_pod_policy=policy
+            ),
+        ),
+    )
+
+
+def job_pods(cs, name):
+    pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+    return pods
+
+
+def test_succeeded_pod_carries_log_tail(cluster):
+    cs, _ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("logs-ok", "logs.chatty"))
+
+    def done():
+        try:
+            return helpers.has_condition(
+                cs.tpujobs().get("logs-ok").status, JobConditionType.SUCCEEDED
+            )
+        except NotFound:
+            return False
+
+    assert wait_for(done)
+    pods = job_pods(cs, "logs-ok")
+    assert len(pods) == 1  # CleanPodPolicy NONE keeps it
+    tail = pods[0].status.log_tail
+    assert sum("chatty line" in l for l in tail) == 5, tail
+    # lines are formatted records (timestamp + level + logger)
+    assert any("tfk8s.test-entrypoint]" in l for l in tail)
+
+
+def test_running_pod_logs_flush_mid_run(cluster):
+    cs, _ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("logs-mid", "logs.slow-chatty"))
+
+    def tail_visible():
+        pods = job_pods(cs, "logs-mid")
+        return (
+            len(pods) == 1
+            and pods[0].status.phase == PodPhase.RUNNING
+            and any("started" in l for l in pods[0].status.log_tail)
+        )
+
+    # the pod never exits during the window, so the tail can only come
+    # from the kubelet's periodic flusher
+    assert wait_for(tail_visible, timeout=20)
+    cs.tpujobs().delete("logs-mid")
+
+
+def test_failed_pod_keeps_logs(cluster):
+    cs, _ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("logs-fail", "logs.failing"))
+
+    def failed_pod_with_tail():
+        pods = job_pods(cs, "logs-fail")
+        return any(
+            p.status.phase == PodPhase.FAILED
+            and any("about to fail" in l for l in p.status.log_tail)
+            for p in pods
+        )
+
+    assert wait_for(failed_pod_with_tail, timeout=30)
+
+
+def test_logs_cli_verb(tmp_path, capsys):
+    """`logs POD` and `logs --job JOB` over the remote apiserver."""
+    from tfk8s_tpu.api.types import Pod, PodSpec, PodStatus
+    from tfk8s_tpu.client.apiserver import APIServer
+    from tfk8s_tpu.client.store import ClusterStore
+    from tfk8s_tpu.cmd.main import main
+
+    store = ClusterStore()
+    server = APIServer(store, port=0)
+    server.serve_background()
+    kc = tmp_path / "kubeconfig.json"
+    kc.write_text(json.dumps({"server": server.url}))
+    try:
+        from tfk8s_tpu.client.clientset import Clientset
+
+        cs = Clientset(store)
+        for i in range(2):
+            cs.pods().create(
+                Pod(
+                    metadata=ObjectMeta(
+                        name=f"lj-worker-{i}",
+                        labels=L.replica_labels("lj", ReplicaType.WORKER, i),
+                    ),
+                    spec=PodSpec(
+                        containers=[ContainerSpec(entrypoint="test.echo")]
+                    ),
+                    status=PodStatus(log_tail=[f"hello from {i}"]),
+                )
+            )
+
+        assert main(["logs", "--kubeconfig", str(kc), "lj-worker-0"]) == 0
+        out = capsys.readouterr().out
+        assert "hello from 0" in out and "hello from 1" not in out
+
+        assert main(["logs", "--kubeconfig", str(kc), "--job", "lj"]) == 0
+        out = capsys.readouterr().out
+        assert "hello from 0" in out and "hello from 1" in out
+        assert "lj-worker-1" in out  # per-pod header
+
+        # exactly one of POD / --job
+        assert main(["logs", "--kubeconfig", str(kc)]) == 1
+        assert (
+            main(["logs", "--kubeconfig", str(kc), "p", "--job", "j"]) == 1
+        )
+        # unknown pod -> clean error, not a traceback
+        assert main(["logs", "--kubeconfig", str(kc), "nope"]) == 1
+    finally:
+        server.shutdown()

@@ -293,6 +293,11 @@ class PodStatus:
     message: str = ""
     host: str = ""
     restarts: int = 0
+    # Bounded container-log tail captured by the kubelet (the hermetic
+    # analogue of `kubectl logs`: real k8s proxies the kubelet for logs;
+    # here the tail rides pod status so any client — including the remote
+    # apiserver path — reads it with a plain GET, no kubelet proxy).
+    log_tail: List[str] = field(default_factory=list)
 
 
 @dataclass

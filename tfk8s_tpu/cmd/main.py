@@ -95,6 +95,12 @@ def _build_parser() -> argparse.ArgumentParser:
 
     p_del = kubectlish("delete", "delete a TPUJob (finalizer-honoring)")
     p_del.add_argument("name")
+
+    p_logs = kubectlish("logs", "print a pod's captured log tail")
+    p_logs.add_argument("name", nargs="?", default="",
+                        help="pod name (omit with --job to dump the whole job)")
+    p_logs.add_argument("--job", default="",
+                        help="print logs for every pod of this TPUJob")
     return parser
 
 
@@ -368,6 +374,35 @@ def _cmd_delete(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_logs(args: argparse.Namespace) -> int:
+    """`kubectl logs` parity: the tail rides pod status (captured by the
+    kubelet, PodStatus.log_tail), so reading it is a plain GET — no
+    kubelet proxy endpoint needed, unlike real k8s."""
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+    from tfk8s_tpu.trainer import labels as L
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    if bool(args.name) == bool(args.job):
+        log.error("logs: pass exactly one of POD_NAME or --job JOB")
+        return 1
+    if args.name:
+        pods = [cs.pods(args.namespace).get(args.name)]
+    else:
+        pods, _rv = cs.pods(args.namespace).list(
+            label_selector=L.job_selector(args.job)
+        )
+        if not pods:
+            log.error("logs: no pods found for job %r", args.job)
+            return 1
+    for pod in sorted(pods, key=lambda p: p.metadata.name):
+        if args.job:
+            print(f"==> {pod.metadata.namespace}/{pod.metadata.name} "
+                  f"({pod.status.phase.value}) <==")
+        for line in pod.status.log_tail:
+            print(line)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.command == "train":
@@ -379,13 +414,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "kubelet":
         init_logging()
         return _cmd_kubelet(args)
-    if args.command in ("submit", "get", "describe", "delete"):
+    if args.command in ("submit", "get", "describe", "delete", "logs"):
         init_logging()
         handler = {
             "submit": _cmd_submit,
             "get": _cmd_get,
             "describe": _cmd_describe,
             "delete": _cmd_delete,
+            "logs": _cmd_logs,
         }[args.command]
         from tfk8s_tpu.client.store import StoreError
 

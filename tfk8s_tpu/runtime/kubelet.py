@@ -17,9 +17,11 @@ exercises restart policies end-to-end.
 
 from __future__ import annotations
 
+import collections
+import logging
 import threading
 import traceback
-from typing import Dict, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from tfk8s_tpu.api.types import Pod, PodPhase
 from tfk8s_tpu.client.clientset import Clientset
@@ -29,6 +31,55 @@ from tfk8s_tpu.runtime import registry
 from tfk8s_tpu.utils.logging import get_logger
 
 log = get_logger("kubelet")
+
+# `kubectl logs` parity: how many tail lines a pod's status carries, and
+# how often the kubelet flushes a running pod's buffer into status.
+LOG_TAIL_LIMIT = 200
+LOG_FLUSH_SECONDS = 1.0
+
+
+class _PodLogRouter(logging.Handler):
+    """Captures the ``tfk8s.*`` log records emitted by pod entrypoint
+    threads into per-pod bounded buffers — the hermetic analogue of the
+    container stdout a real node agent captures. Routing is by thread
+    ident: each pod runs on its own kubelet thread, so a record's
+    ``record.thread`` names its pod (child threads an entrypoint spawns
+    are not captured — same as a container process writing to a file
+    instead of stdout)."""
+
+    def __init__(self):
+        super().__init__()
+        self.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname).1s %(name)s] %(message)s")
+        )
+        self._by_thread: Dict[int, Deque[str]] = {}
+        self._route_lock = threading.Lock()
+
+    def register(self, ident: int) -> Deque[str]:
+        buf: Deque[str] = collections.deque(maxlen=LOG_TAIL_LIMIT)
+        with self._route_lock:
+            self._by_thread[ident] = buf
+        return buf
+
+    def unregister(self, ident: int) -> None:
+        with self._route_lock:
+            self._by_thread.pop(ident, None)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        # append under the route lock: the flusher snapshots buffers with
+        # list(buf), which raises 'deque mutated during iteration' if an
+        # append lands mid-copy
+        with self._route_lock:
+            buf = self._by_thread.get(record.thread)
+            if buf is not None:
+                try:
+                    buf.append(self.format(record))
+                except Exception:  # noqa: BLE001 — logging must never raise
+                    pass
+
+    def snapshot(self, buf: Deque[str]) -> List[str]:
+        with self._route_lock:
+            return list(buf)
 
 
 class LocalKubelet:
@@ -49,10 +100,79 @@ class LocalKubelet:
         self._lock = threading.Lock()
         self._stop: Optional[threading.Event] = None
         self._fail_counts: Dict[str, int] = {}
+        # (pod key, uid) -> live log buffer, drained by the flusher
+        self._log_bufs: Dict[Tuple[str, str], Deque[str]] = {}
+        # last tail actually published per pod — skips the per-cycle GET
+        # for pods whose buffer hasn't changed
+        self._log_published: Dict[Tuple[str, str], List[str]] = {}
+        self._log_router = _PodLogRouter()
 
     def run(self, stop: threading.Event) -> None:
         self._stop = stop
+        tfk8s_logger = logging.getLogger("tfk8s")
+        tfk8s_logger.addHandler(self._log_router)
+        # The node agent must see container INFO logs even when the
+        # process never called init_logging (hermetic tests): an unset
+        # level would inherit the root default (WARNING) and drop the
+        # records before they reach any handler.
+        if tfk8s_logger.getEffectiveLevel() > logging.INFO:
+            tfk8s_logger.setLevel(logging.INFO)
         self.informer.run(stop)
+        threading.Thread(
+            target=self._flush_logs_loop, name=f"{self.name}-logflush", daemon=True
+        ).start()
+
+    # -- pod log plumbing ---------------------------------------------------
+
+    def _flush_logs_loop(self) -> None:
+        """Periodically publish running pods' captured log tails into pod
+        status, so `logs` works mid-run (final flush rides the terminal
+        _set_phase). Runs OUTSIDE the logging handler — a flush that
+        itself logs (update conflicts) must not recurse into capture."""
+        while self._stop is not None and not self._stop.is_set():
+            try:
+                with self._lock:
+                    snapshot = {
+                        k: self._log_router.snapshot(buf)
+                        for k, buf in self._log_bufs.items()
+                    }
+                for (key, uid), lines in snapshot.items():
+                    if lines and self._log_published.get((key, uid)) != lines:
+                        self._publish_logs(key, uid, lines)
+            except Exception:  # noqa: BLE001 — the flusher must survive
+                log.debug("log flush cycle failed:\n%s", traceback.format_exc())
+            self._stop.wait(LOG_FLUSH_SECONDS)
+        logging.getLogger("tfk8s").removeHandler(self._log_router)
+
+    def _publish_logs(self, pod_key: str, uid: str, lines: List[str]) -> bool:
+        # the terminal _set_phase owns the FINAL tail: once the pod's
+        # buffer is retired, a stale snapshot must not overwrite it
+        with self._lock:
+            if (pod_key, uid) not in self._log_bufs:
+                return False
+        ns, name = pod_key.split("/", 1)
+        for _ in range(3):
+            try:
+                current = self.cs.pods(ns).get(name)
+            except NotFound:
+                return False
+            if current.metadata.uid != uid:
+                return False
+            if current.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                return False  # terminal writer already published
+            if current.status.log_tail == lines:
+                self._log_published[(pod_key, uid)] = lines
+                return True  # nothing new since the last flush
+            current.status.log_tail = lines
+            try:
+                self.cs.pods(ns).update_status(current)
+                self._log_published[(pod_key, uid)] = lines
+                return True
+            except Conflict:
+                continue
+            except NotFound:
+                return False
+        return False
 
     # -- pod lifecycle ------------------------------------------------------
 
@@ -93,7 +213,10 @@ class LocalKubelet:
         )
         t.start()
 
-    def _set_phase(self, pod_key: str, uid: str, phase: PodPhase, message: str = "", exit_code=None) -> bool:
+    def _set_phase(
+        self, pod_key: str, uid: str, phase: PodPhase, message: str = "",
+        exit_code=None, log_tail: Optional[List[str]] = None,
+    ) -> bool:
         ns, name = pod_key.split("/", 1)
         for _ in range(5):
             try:
@@ -106,6 +229,8 @@ class LocalKubelet:
             current.status.message = message
             current.status.exit_code = exit_code
             current.status.host = self.name
+            if log_tail is not None:
+                current.status.log_tail = log_tail
             try:
                 self.cs.pods(ns).update_status(current)
                 return True
@@ -118,6 +243,10 @@ class LocalKubelet:
 
     def _run_pod(self, pod: Pod, pod_stop: threading.Event) -> None:
         key, uid = pod.metadata.key, pod.metadata.uid
+        ident = threading.get_ident()
+        buf = self._log_router.register(ident)
+        with self._lock:
+            self._log_bufs[(key, uid)] = buf
         try:
             container = pod.spec.containers[0]
             env = dict(container.env)
@@ -133,7 +262,9 @@ class LocalKubelet:
                     raise RuntimeError(f"injected failure {n + 1}/{fail_times}")
             fn = registry.resolve(container.entrypoint)
             registry.call(fn, env, pod_stop)
-            self._set_phase(key, uid, PodPhase.SUCCEEDED, exit_code=0)
+            self._set_phase(
+                key, uid, PodPhase.SUCCEEDED, exit_code=0, log_tail=list(buf)
+            )
         except Exception as e:  # noqa: BLE001 — container failure, not ours
             log.info("%s: pod %s failed: %s", self.name, key, e)
             self._set_phase(
@@ -142,8 +273,12 @@ class LocalKubelet:
                 PodPhase.FAILED,
                 message=f"{type(e).__name__}: {e}",
                 exit_code=1,
+                log_tail=list(buf),
             )
             log.debug("%s", traceback.format_exc())
         finally:
+            self._log_router.unregister(ident)
             with self._lock:
                 self._claimed.pop((key, uid), None)
+                self._log_bufs.pop((key, uid), None)
+                self._log_published.pop((key, uid), None)
