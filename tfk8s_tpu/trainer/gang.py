@@ -193,51 +193,106 @@ class SliceAllocator:
             )
         return None
 
+    @staticmethod
+    def _cpu_hosts_per_slice(job: TPUJob, want: int) -> int:
+        from tfk8s_tpu.api import helpers as _h
+
+        return -(-max(_h.total_replicas(job), 1) // want)  # ceil div
+
+    def _assignment_fits(
+        self, ga: GangAssignment, job: TPUJob, info: topo.SliceInfo, want: int
+    ) -> bool:
+        """Does a held assignment still satisfy the job's CURRENT spec?
+        False after a scale / accelerator / num_slices edit — the gang
+        must be released and re-admitted (slices are whole-gang units;
+        there is no partial grow/shrink on TPU hardware)."""
+        if len(ga.slices) != want or ga.slices[0].info != info:
+            return False
+        if info.generation == "cpu":
+            return ga.hosts_per_slice == self._cpu_hosts_per_slice(job, want)
+        return ga.hosts_per_slice == info.hosts
+
     def admit(self, job: TPUJob) -> Optional[GangAssignment]:
         """All-or-nothing: returns an assignment of ``num_slices``
         contiguous sub-slices, or None if capacity is short. Idempotent
-        per job uid."""
+        per job uid while the spec's demand is unchanged. A demand edit
+        (scale, accelerator, num_slices) re-admits atomically: the held
+        boxes are offered back to the pool for the new carve, but if the
+        new demand cannot be satisfied the old assignment is RESTORED
+        intact — the running gang keeps its hosts (no double-booking
+        window) and the caller sees None (gang pending)."""
         uid = job.metadata.uid
         with self._lock:
-            if uid in self._assigned:
-                return self._assigned[uid]
             info = topo.parse_accelerator(job.spec.tpu.accelerator, job.spec.tpu.topology)
             want = max(job.spec.tpu.num_slices, 1)
-            if info.generation == "cpu":
-                # Local/hermetic backend: slices are virtual and unlimited,
-                # and every replica is a "host" of its virtual slice (cpu
-                # jobs aren't bound by physical host counts — validation
-                # exempts them too).
-                from tfk8s_tpu.api import helpers as _h
-
-                total = max(_h.total_replicas(job), 1)
-                hosts_per_slice = -(-total // want)  # ceil div
-                handles = []
-                for _ in range(want):
-                    handles.append(
-                        SliceHandle(f"cpu/slice-{self._cpu_counter}", info.accelerator, info)
+            held = self._assigned.get(uid)
+            if held is not None and self._assignment_fits(held, job, info, want):
+                return held
+            if held is None:
+                ga = self._admit_locked(job, info, want, uid)
+                if ga is not None:
+                    self._assigned[uid] = ga
+                    self.version += 1
+                    log.info(
+                        "admitted job uid=%s onto %s",
+                        uid, [h.slice_id for h in ga.slices],
                     )
-                    self._cpu_counter += 1
-                ga = GangAssignment(uid, handles, hosts_per_slice=hosts_per_slice)
-                self._assigned[uid] = ga
                 return ga
-
-            handles: List[SliceHandle] = []
-            for _ in range(want):
-                h = self._find_box(info)
-                if h is None:
-                    # all-or-nothing: roll back partial carves
-                    for got in handles:
-                        self._release_handle(got)
-                    return None
-                handles.append(h)
-            ga = GangAssignment(uid, handles, hosts_per_slice=info.hosts)
+            # Demand changed. Snapshot the free lists so a failed re-carve
+            # restores the world exactly (the held boxes may be needed by,
+            # or adjacent to, the new shape — release first, then carve).
+            snapshot = {
+                sid: list(free) for sid, (_ps, free) in self._slices.items()
+            }
+            for h in held.slices:
+                self._release_handle(h)
+            ga = self._admit_locked(job, info, want, uid)
+            if ga is None:
+                for sid, boxes in snapshot.items():
+                    ps, _stale = self._slices[sid]
+                    self._slices[sid] = (ps, boxes)
+                log.debug(
+                    "job uid=%s demand change unsatisfiable; keeping old gang",
+                    uid,
+                )
+                return None
             self._assigned[uid] = ga
             self.version += 1
             log.info(
-                "admitted job uid=%s onto %s", uid, [h.slice_id for h in handles]
+                "job uid=%s demand changed; re-admitted onto %s",
+                uid, [h.slice_id for h in ga.slices],
             )
             return ga
+
+    def _admit_locked(
+        self, job: TPUJob, info: topo.SliceInfo, want: int, uid: str
+    ) -> Optional[GangAssignment]:
+        """Carve ``want`` slices for the job, or None (partial carves
+        rolled back). Caller holds the lock and owns ``_assigned``."""
+        if info.generation == "cpu":
+            # Local/hermetic backend: slices are virtual and unlimited,
+            # and every replica is a "host" of its virtual slice (cpu
+            # jobs aren't bound by physical host counts — validation
+            # exempts them too).
+            hosts_per_slice = self._cpu_hosts_per_slice(job, want)
+            handles = []
+            for _ in range(want):
+                handles.append(
+                    SliceHandle(f"cpu/slice-{self._cpu_counter}", info.accelerator, info)
+                )
+                self._cpu_counter += 1
+            return GangAssignment(uid, handles, hosts_per_slice=hosts_per_slice)
+
+        handles: List[SliceHandle] = []
+        for _ in range(want):
+            h = self._find_box(info)
+            if h is None:
+                # all-or-nothing: roll back partial carves
+                for got in handles:
+                    self._release_handle(got)
+                return None
+            handles.append(h)
+        return GangAssignment(uid, handles, hosts_per_slice=info.hosts)
 
     def _release_handle(self, h: SliceHandle) -> None:
         if h.physical is None or h.box is None:

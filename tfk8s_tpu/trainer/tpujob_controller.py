@@ -59,6 +59,19 @@ FINALIZER = "tfk8s.dev/job-cleanup"
 RESTARTS_ANNOTATION = "tfk8s.dev/restarts"
 PENDING_REQUEUE_S = 0.5
 
+# Env keys derived from the (in-memory) SliceAllocator's placement rather
+# than the job spec; excluded from the stale-render diff in
+# _reconcile_replicas so an operator restart doesn't churn running gangs.
+_PLACEMENT_ENV_KEYS = frozenset({"TFK8S_SLICE_ID", "TFK8S_HOST_INDEX"})
+
+
+def _contract_env(pod) -> dict:
+    return {
+        k: v
+        for k, v in pod.spec.containers[0].env.items()
+        if k not in _PLACEMENT_ENV_KEYS
+    }
+
 
 class TPUJobController:
     """Owns the TPUJob/Pod/Service informers and the reconcile logic."""
@@ -99,14 +112,22 @@ class TPUJobController:
         )
         self.job_informer.add_event_handler(self.controller.default_handler())
         # Pod/Service events reconcile their owning job (the enqueuePod
-        # pattern of k8s-operator.md:132-139, re-keyed to the owner).
-        owner_handler = ResourceEventHandler(
+        # pattern of k8s-operator.md:132-139, re-keyed to the owner) —
+        # with the reference's update filter (k8s-operator.md:142-150):
+        # a pod update that only refreshed status.log_tail (the kubelet's
+        # periodic log flush) changes nothing a reconcile acts on, and
+        # enqueueing it would cost one full job sync per chatty pod per
+        # flush interval.
+        self.pod_informer.add_event_handler(ResourceEventHandler(
+            on_add=self._enqueue_owner,
+            on_update=self._pod_updated,
+            on_delete=self._enqueue_owner,
+        ))
+        self.svc_informer.add_event_handler(ResourceEventHandler(
             on_add=self._enqueue_owner,
             on_update=lambda old, new: self._enqueue_owner(new),
             on_delete=self._enqueue_owner,
-        )
-        self.pod_informer.add_event_handler(owner_handler)
-        self.svc_informer.add_event_handler(owner_handler)
+        ))
         # gang release needs the uid after the job object is gone
         self._uid_by_key: dict = {}
         # pod name -> restart count to stamp on the next recreation
@@ -125,6 +146,21 @@ class TPUJobController:
         job_name = meta.labels.get(L.JOB_NAME)
         if job_name:
             self.controller.enqueue_key(f"{meta.namespace}/{job_name}")
+
+    def _pod_updated(self, old: Pod, new: Pod) -> None:
+        if (
+            old.metadata.resource_version != new.metadata.resource_version
+            and old.metadata.uid == new.metadata.uid
+            and old.metadata.deletion_timestamp == new.metadata.deletion_timestamp
+            and old.status.phase == new.status.phase
+            and old.status.exit_code == new.status.exit_code
+            and old.status.message == new.status.message
+            and old.status.restarts == new.status.restarts
+            and old.spec == new.spec
+            and old.status.log_tail != new.status.log_tail
+        ):
+            return  # log-flush-only refresh; nothing to reconcile
+        self._enqueue_owner(new)
 
     def run(self, workers: int, stop, block: bool = True) -> bool:
         return self.controller.run(workers, stop, block=block)
@@ -193,6 +229,14 @@ class TPUJobController:
             self.metrics.inc("tpujob.gang_pending")
             timeout = job.spec.run_policy.scheduling.admission_timeout_s
             created = helpers.get_condition(job.status, JobConditionType.CREATED)
+            # The timeout bounds INITIAL admission only. A running job can
+            # land here after a demand edit the pool can't satisfy (the
+            # allocator kept its old gang — gang.py admit); measuring that
+            # against job-creation time would insta-fail any long-running
+            # job on its first unsatisfiable scale request.
+            if helpers.has_condition(job.status, JobConditionType.RUNNING):
+                self.controller.enqueue_after(key, PENDING_REQUEUE_S)
+                return
             if timeout and created and time.time() - created.last_transition_time > timeout:
                 helpers.set_condition(
                     job.status, JobConditionType.FAILED,
@@ -256,6 +300,35 @@ class TPUJobController:
                 self.cs.services(ns).delete(sname)
             except NotFound:
                 pass
+
+        # Stale renders (scale-up / template edit): a live pod whose
+        # desired env differs from what it was started with cannot serve
+        # the new cluster spec — the coordination contract
+        # (TFK8S_NUM_PROCESSES / TFK8S_CLUSTER_SPEC / TFK8S_MESH,
+        # trainer/replicas.py) is baked in at process start. Delete it;
+        # level-triggered recreation (next sync) brings the gang back
+        # consistent. Scaling a replica set therefore replaces the whole
+        # gang in one reconcile pass — the honest TPU semantics (the
+        # reference's async-PS world could add workers live; a
+        # collective gang cannot, SURVEY.md §2 'Elastic/gang').
+        # Allocator-derived placement keys are EXCLUDED from the diff:
+        # the SliceAllocator is in-memory, so an operator restart
+        # re-admits every job onto freshly-named boxes — a placement-key
+        # diff would then spuriously gang-restart the whole cluster.
+        desired_by_name = {p.metadata.name: p for p in desired_pods}
+        for pname, pod in observed.items():
+            want = desired_by_name.get(pname)
+            if (
+                want is not None
+                and pod.metadata.deletion_timestamp is None
+                and pod.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+                and _contract_env(pod) != _contract_env(want)
+            ):
+                self.recorder.event(
+                    "TPUJob", key, "PodReplaced",
+                    f"{pname}: coordination env changed (scale or template edit)",
+                )
+                self._delete_pod(ns, pname)
 
         # Failure accounting before creation, so a gang restart deletes
         # pods instead of racing recreation.
