@@ -34,6 +34,7 @@ from tfk8s_tpu.client.remote import (
 )
 from tfk8s_tpu.client.store import (
     AlreadyExists, ClusterStore, Conflict, EventType, Gone, NotFound,
+    StoreError,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -64,6 +65,49 @@ def make_job(name, entrypoint="test.echo", **env):
             run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
         ),
     )
+
+
+class TestAdmission:
+    """Write-time admission (the CRD validating-webhook parity): invalid
+    TPUJob specs are rejected with 422 Invalid at the API boundary,
+    defaults are applied by the API machinery before persisting."""
+
+    def test_invalid_create_rejected_422(self, api):
+        _server, store = api
+        bad = make_job("bad-acc")
+        bad.spec.tpu.accelerator = "v5p-33"  # odd TensorCore count
+        with pytest.raises(StoreError, match="422 Invalid"):
+            store.create(bad)
+        with pytest.raises(NotFound):
+            store.get("TPUJob", "default", "bad-acc")
+
+    def test_invalid_update_rejected_422(self, api):
+        _server, store = api
+        created = store.create(make_job("mutate-me"))
+        created.spec.tpu.accelerator = "warp-drive"
+        with pytest.raises(StoreError, match="422 Invalid"):
+            store.update(created)
+        # stored object unchanged
+        assert (
+            store.get("TPUJob", "default", "mutate-me").spec.tpu.accelerator
+            == "cpu-1"
+        )
+
+    def test_defaults_applied_at_admission(self, api):
+        _server, store = api
+        created = store.create(make_job("defaulted"))
+        # set_defaults fills the mesh from the accelerator's chip count
+        assert created.spec.mesh is not None and created.spec.mesh.axes
+
+    def test_non_tpujob_kinds_skip_admission(self, api):
+        _server, store = api
+        from tfk8s_tpu.api.types import Pod, PodSpec
+
+        pod = Pod(
+            metadata=ObjectMeta(name="raw-pod", namespace="default"),
+            spec=PodSpec(containers=[ContainerSpec(entrypoint="x:y")]),
+        )
+        assert store.create(pod).metadata.uid
 
 
 class TestRemoteCRUD:
@@ -290,13 +334,13 @@ class TestCrossProcessE2E:
                     env=env, cwd=REPO,
                 )
             )
-            deadline = time.time() + 20
+            deadline = time.time() + 60  # generous: subprocess interpreter start imports jax via sitecustomize, slow under load
             while time.time() < deadline and not os.path.exists(kubeconfig):
                 time.sleep(0.1)
             assert os.path.exists(kubeconfig), "apiserver never wrote kubeconfig"
             cfg = load_kubeconfig(kubeconfig)
             store = RemoteStore(cfg.server)
-            deadline = time.time() + 20
+            deadline = time.time() + 60  # generous: subprocess interpreter start imports jax via sitecustomize, slow under load
             while time.time() < deadline and not store.healthz():
                 time.sleep(0.1)
             assert store.healthz()

@@ -71,6 +71,10 @@ KIND_TO_PLURAL = {v: k for k, v in PLURALS.items()}
 _HEARTBEAT_S = 2.0
 
 
+class _AdmissionRejected(Exception):
+    """Invalid TPUJob write — mapped to 422 Invalid by the error sender."""
+
+
 def _err_body(status: int, reason: str, message: str) -> bytes:
     return json.dumps(
         {"kind": "Status", "code": status, "reason": reason, "message": message}
@@ -105,6 +109,8 @@ class _Handler(BaseHTTPRequestHandler):
             status, reason = 409, "Conflict"
         elif isinstance(exc, Gone):
             status, reason = 410, "Gone"
+        elif isinstance(exc, _AdmissionRejected):
+            status, reason = 422, "Invalid"
         else:
             status, reason = 500, "InternalError"
             log.warning("apiserver 500: %s", exc)
@@ -181,6 +187,21 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 — mapped to protocol errors
             self._send_store_error(e)
 
+    def _admit(self, obj) -> None:
+        """Admission for TPUJob writes (the CRD webhook's job, done by the
+        API machinery here): apply defaults, then validate — invalid specs
+        are rejected at the boundary with 422 Invalid, like a validating
+        webhook, instead of being persisted and later failed by the
+        controller. Raises :class:`_AdmissionRejected` on invalid specs."""
+        if obj.kind != "TPUJob" or not self.server.admission:
+            return
+        from tfk8s_tpu.api import set_defaults, validate
+
+        set_defaults(obj)
+        errs = validate(obj)
+        if errs:
+            raise _AdmissionRejected("; ".join(errs))
+
     def do_POST(self) -> None:
         route = self._route()
         if route is None:
@@ -191,6 +212,7 @@ class _Handler(BaseHTTPRequestHandler):
             obj = serde.decode_object(self._read_body())
             if ns:
                 obj.metadata.namespace = ns
+            self._admit(obj)
             created = self.server.store.create(obj)
             self._send_json(201, serde.to_dict(created))
         except Exception as e:  # noqa: BLE001
@@ -226,6 +248,7 @@ class _Handler(BaseHTTPRequestHandler):
             if is_status:
                 updated = self.server.store.update_status(obj)
             else:
+                self._admit(obj)
                 updated = self.server.store.update(obj)
             self._send_json(200, serde.to_dict(updated))
         except Exception as e:  # noqa: BLE001
@@ -294,8 +317,15 @@ class APIServer(ThreadingHTTPServer):
     # watches hold sockets open; allow plenty of concurrent streams
     request_queue_size = 64
 
-    def __init__(self, store: ClusterStore, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        store: ClusterStore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admission: bool = True,
+    ):
         self.store = store
+        self.admission = admission
         self.stopping = threading.Event()
         super().__init__((host, port), _Handler)
 
