@@ -103,6 +103,44 @@ def test_submit_namespace_flag_wins(cluster, tmp_path, capsys):
     assert "ns-job" in capsys.readouterr().out
 
 
+def test_get_watch_streams_changes(cluster, tmp_path, capsys):
+    """`get -w` parity: initial table, then one line per ADDED/MODIFIED/
+    DELETED event until --watch-timeout elapses."""
+    import threading
+
+    from tfk8s_tpu.client.remote import RemoteStore
+
+    server, kc = cluster
+    store = RemoteStore(server.url)
+    manifest = write_manifest(tmp_path, name="watched")
+
+    rc = {}
+
+    def run_watch():
+        rc["v"] = main([
+            "get", "--kubeconfig", kc, "-w", "--watch-timeout", "4",
+        ])
+
+    t = threading.Thread(target=run_watch)
+    t.start()
+    import time
+
+    time.sleep(1.0)  # let the watcher list + open its stream
+    assert main(["submit", "--kubeconfig", kc, "--file", manifest]) == 0
+    time.sleep(0.5)
+    job = store.get("TPUJob", "default", "watched")
+    job.status.gang_restarts = 1
+    store.update_status(job)
+    time.sleep(0.5)
+    store.delete("TPUJob", "default", "watched")
+    t.join(timeout=10)
+    assert not t.is_alive() and rc["v"] == 0
+    out = capsys.readouterr().out
+    assert "ADDED     watched" in out
+    assert "MODIFIED  watched" in out
+    assert "DELETED   watched" in out
+
+
 def test_user_errors_exit_1_not_traceback(cluster, tmp_path):
     _server, kc = cluster
     assert main(["get", "--kubeconfig", str(tmp_path / "nope.json")]) == 1

@@ -89,6 +89,10 @@ def _build_parser() -> argparse.ArgumentParser:
                        default="table")
     p_get.add_argument("--kind", default="tpujobs",
                        choices=("tpujobs", "pods", "services"))
+    p_get.add_argument("-w", "--watch", action="store_true",
+                       help="after listing, stream changes (kubectl get -w)")
+    p_get.add_argument("--watch-timeout", type=float, default=0.0,
+                       help="stop watching after N seconds (0 = forever)")
 
     p_desc = kubectlish("describe", "full detail of one TPUJob")
     p_desc.add_argument("name")
@@ -324,10 +328,13 @@ def _cmd_get(args: argparse.Namespace) -> int:
     )
     if args.name:
         objs = [client.get(args.name)]
+        rv = objs[0].metadata.resource_version
     else:
-        objs, _rv = client.list()
+        objs, rv = client.list()
     if args.output == "json":
         print(json.dumps([serde.to_dict(o) for o in objs], indent=2))
+        if getattr(args, "watch", False):
+            return _stream_watch(client, args, rv)
         return 0
     if args.kind == "tpujobs":
         rows = [("NAME", "PHASE", "RESTARTS", "AGE")] + [
@@ -352,6 +359,58 @@ def _cmd_get(args: argparse.Namespace) -> int:
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    if getattr(args, "watch", False):
+        return _stream_watch(client, args, rv)
+    return 0
+
+
+def _stream_watch(client, args: argparse.Namespace, since_rv: int) -> int:
+    """`kubectl get -w` parity: after the initial table, stream one line
+    per change event from the apiserver's watch endpoint (the same
+    List-then-Watch contract the reflector uses, images/informer1.png)
+    until interrupted or --watch-timeout elapses."""
+    import time as _time
+
+    from tfk8s_tpu.api import serde
+
+    def phase_of(o) -> str:
+        status = getattr(o, "status", None)
+        phase = getattr(status, "phase", "") if status is not None else ""
+        if args.kind == "tpujobs":
+            phase = _job_phase(o)
+        return str(getattr(phase, "value", phase)) or "-"
+
+    w = client.watch(since_rv=since_rv)
+    deadline = (
+        _time.time() + args.watch_timeout if args.watch_timeout else None
+    )
+    try:
+        while deadline is None or _time.time() < deadline:
+            ev = w.next(timeout=0.5)
+            if ev is None:
+                continue
+            if ev.object.metadata.namespace != args.namespace:
+                continue
+            if args.name and ev.object.metadata.name != args.name:
+                continue
+            if args.output == "json":
+                print(
+                    json.dumps(
+                        {"type": ev.type.value,
+                         "object": serde.to_dict(ev.object)}
+                    ),
+                    flush=True,
+                )
+            else:
+                print(
+                    f"{ev.type.value:<9} {ev.object.metadata.name}  "
+                    f"{phase_of(ev.object)}",
+                    flush=True,
+                )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        w.stop()
     return 0
 
 
