@@ -1,0 +1,126 @@
+"""Leader-election failover across the apiserver seam (SURVEY.md C17,
+k8s-operator.md:59 'leaderelection for HA'): two full operator Servers
+share one Lease through the HTTP apiserver; exactly one reconciles at a
+time, and when the leader goes away the standby takes over and drives
+the next job to completion. The kubelet runs standalone (one node),
+exactly like the multi-process deployment in README.md."""
+
+import json
+import threading
+
+import pytest
+
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.types import (
+    ContainerSpec, JobConditionType, ObjectMeta, ReplicaSpec, ReplicaType,
+    RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
+)
+from tfk8s_tpu.client.apiserver import APIServer
+from tfk8s_tpu.client.clientset import Clientset
+from tfk8s_tpu.client.remote import RemoteStore
+from tfk8s_tpu.client.store import ClusterStore, NotFound
+from tfk8s_tpu.cmd.options import Options
+from tfk8s_tpu.cmd.server import Server
+from tfk8s_tpu.runtime import LocalKubelet
+
+from conftest import wait_for
+
+from tfk8s_tpu.runtime import registry
+
+
+@registry.register("le.echo")
+def _echo(env):
+    pass
+
+
+def make_job(name):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint="le.echo")
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+        ),
+    )
+
+
+def opts(identity, kc):
+    # lease_duration must exceed the elector's renew period (5s), or a
+    # healthy leader's lease would expire between its own renewals
+    return Options(
+        leader_elect=True,
+        identity=identity,
+        lease_name="ha-test",
+        lease_duration_s=8.0,
+        local_kubelet=False,
+        kubeconfig=kc,
+        workers=1,
+    )
+
+
+def test_two_operators_one_leader_failover(tmp_path):
+    server = APIServer(ClusterStore(), port=0)
+    server.serve_background()
+    kc = tmp_path / "kubeconfig.json"
+    kc.write_text(json.dumps({"server": server.url}))
+
+    # one standalone node agent, independent of either operator
+    kubelet_cs = Clientset.new_for_config(RemoteStore(server.url))
+    kubelet_stop = threading.Event()
+    LocalKubelet(kubelet_cs, name="node-0").run(kubelet_stop)
+
+    stop_a, stop_b = threading.Event(), threading.Event()
+    op_a = Server(opts("op-a", str(kc)))
+    op_b = Server(opts("op-b", str(kc)))
+    submit = RemoteStore(server.url)
+
+    try:
+        op_a.run(stop_a, block=False)
+        assert wait_for(lambda: getattr(op_a, "elector", None) and op_a.elector.is_leader)
+        op_b.run(stop_b, block=False)
+
+        # the standby must NOT grab the live lease
+        import time
+        time.sleep(1.0)
+        assert not (getattr(op_b, "elector", None) and op_b.elector.is_leader)
+
+        # leader reconciles a job to completion
+        submit.create(make_job("ha-1"))
+
+        def done(name):
+            def check():
+                try:
+                    return helpers.has_condition(
+                        submit.get("TPUJob", "default", name).status,
+                        JobConditionType.SUCCEEDED,
+                    )
+                except NotFound:
+                    return False
+            return check
+
+        assert wait_for(done("ha-1"), timeout=60)
+
+        # leader goes away (graceful stop releases the lease) -> failover
+        stop_a.set()
+        op_a.shutdown()
+        assert wait_for(lambda: op_b.elector.is_leader, timeout=30), (
+            "standby never took over the lease"
+        )
+
+        # the new leader drives the next job
+        submit.create(make_job("ha-2"))
+        assert wait_for(done("ha-2"), timeout=60)
+    finally:
+        stop_a.set()
+        stop_b.set()
+        kubelet_stop.set()
+        for op in (op_a, op_b):
+            try:
+                op.shutdown()
+            except Exception:
+                pass
+        server.shutdown()

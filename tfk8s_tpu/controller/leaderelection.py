@@ -101,14 +101,16 @@ class LeaderElector:
         return True
 
     def release(self) -> None:
-        """Voluntarily drop the lease so a standby takes over immediately."""
+        """Voluntarily drop the lease so a standby takes over immediately.
+        Best-effort: on a dead/unreachable apiserver (process teardown)
+        the lease simply expires instead — never raise from here."""
         try:
             lease = self.client.get(self.lease_name)
             if lease.spec.holder == self.identity:
                 lease.spec.holder = ""
                 lease.spec.renew_time = None
                 self.client.update(lease)
-        except (NotFound, Conflict):
+        except Exception:  # noqa: BLE001 — includes remote transport errors
             pass
         self._is_leader = False
 
