@@ -1,0 +1,114 @@
+"""Gradient accumulation (TrainConfig.grad_accum_steps): microbatches
+scanned inside the jitted step, grads averaged, ONE optimizer update —
+numerically equivalent to the single-shot step on the same total batch,
+and sharding-compatible (the batch dim moves to dim 1, accumulation dim
+unsharded). The reference world's large-batch recipe, TPU-style: no
+extra HBM for the full batch's activations."""
+
+import jax
+import numpy as np
+import pytest
+
+from tfk8s_tpu.models import mlp
+from tfk8s_tpu.parallel.mesh import make_mesh
+from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+
+def _losses(mesh, accum, steps=4, batch_size=16):
+    task = mlp.make_task(batch_size=batch_size)
+    trainer = Trainer(
+        task,
+        TrainConfig(steps=steps, learning_rate=1e-3, log_every=10,
+                    grad_accum_steps=accum),
+        mesh,
+    )
+    _state, history = trainer.fit()
+    return [h["loss"] for h in history]
+
+
+def test_accum_matches_single_shot():
+    """Same data, same RNG schedule is impossible across the two modes
+    (per-microbatch rng folds), so equivalence is checked at the GRADIENT
+    level: one step, identical params, hand-built microbatches."""
+    import jax.numpy as jnp
+    import optax
+
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    mesh = make_mesh(data=1)
+    task = mlp.make_task(batch_size=16)
+    params = unbox(task.init(jax.random.key(0)))
+    batch = task.make_batch(np.random.default_rng(0), 16)
+    r = jax.random.key(7)
+
+    tr1 = Trainer(task, TrainConfig(grad_accum_steps=1), mesh)
+    tr2 = Trainer(task, TrainConfig(grad_accum_steps=4), mesh)
+
+    s1 = tr1.init_state()
+    s2 = tr2.init_state()
+    # identical initial params by construction (same seed)
+    out1, m1 = tr1._step_fn(s1, jax.device_put(batch, tr1.batch_shardings), r)
+
+    # accum path: microbatch i gets fold_in(r, i); to compare gradients
+    # exactly we recompute the single-shot average with the same folds
+    micro = tr2.prepare_batch(batch)
+    out2, m2 = tr2._step_fn(s2, jax.device_put(micro, tr2.batch_shardings), r)
+
+    def ref_grads(params):
+        gsum = None
+        lsum = 0.0
+        for i in range(4):
+            mb = jax.tree_util.tree_map(lambda x: x[i], micro)
+            (loss, _aux), g = jax.value_and_grad(
+                lambda p: task.loss_fn(p, mb, jax.random.fold_in(r, i)),
+                has_aux=True,
+            )(params)
+            lsum += float(loss)
+            gsum = g if gsum is None else jax.tree_util.tree_map(
+                jnp.add, gsum, g
+            )
+        return lsum / 4, jax.tree_util.tree_map(lambda g: g / 4, gsum)
+
+    want_loss, want_grads = ref_grads(unbox(task.init(jax.random.key(0))))
+    np.testing.assert_allclose(float(m2["loss"]), want_loss, atol=1e-5)
+    # applying the averaged grads through the same optimizer yields the
+    # same params as the reference average
+    want_norm = float(optax.global_norm(want_grads))
+    np.testing.assert_allclose(float(m2["grad_norm"]), want_norm, atol=1e-5)
+    # and the single-shot step on the SAME full batch is close (different
+    # rng folding per microbatch, but mlp's loss is rng-independent)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), atol=1e-5)
+    p1 = jax.tree_util.tree_leaves(out1.params)
+    p2 = jax.tree_util.tree_leaves(out2.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_accum_trains_on_dp_mesh():
+    mesh = make_mesh(data=2, fsdp=2)
+    losses = _losses(mesh, accum=2, steps=80, batch_size=32)
+    assert losses[-1] < losses[0]
+
+
+def test_accum_must_divide_batch():
+    mesh = make_mesh(data=1)
+    task = mlp.make_task(batch_size=10)
+    with pytest.raises(ValueError, match="does not divide"):
+        Trainer(task, TrainConfig(grad_accum_steps=3), mesh)
+
+
+def test_accum_env_knob():
+    from tfk8s_tpu.runtime.train import run_task
+
+    task = mlp.make_task(batch_size=8)
+    task.targets = {}  # 5 steps will not converge; knob plumbing is the test
+    final = run_task(
+        task,
+        env={
+            "TFK8S_TRAIN_STEPS": "5",
+            "TFK8S_GRAD_ACCUM": "2",
+            "TFK8S_LOG_EVERY": "5",
+        },
+        mesh=make_mesh(data=1),
+    )
+    assert np.isfinite(final["loss"])

@@ -78,6 +78,13 @@ class TrainConfig:
     seed: int = 0
     resume: bool = False
     optimizer: Optional[optax.GradientTransformation] = None
+    # Gradient accumulation: >1 splits each batch into this many
+    # microbatches scanned INSIDE the jitted step (grads averaged, one
+    # optimizer update) — the standard large-effective-batch /
+    # HBM-relief trade. The host batch is reshaped to
+    # [accum, B/accum, ...] and sharding moves to the microbatch dim, so
+    # per-device microbatches stay contiguous (no reshape collectives).
+    grad_accum_steps: int = 1
     # jax.profiler trace output dir (SURVEY.md §5 'Tracing: ABSENT' in the
     # reference — the build's addition); empty disables
     profile_dir: str = ""
@@ -154,11 +161,59 @@ class Trainer:
 
         self._init_fn = jax.jit(_init, out_shardings=self.state_shardings)
 
-        def _step(state: TrainState, batch, r):
-            def loss_fn(p):
-                return task.loss_fn(p, batch, r)
+        accum = max(self.config.grad_accum_steps, 1)
+        if accum > 1 and task.batch_size % accum:
+            raise ValueError(
+                f"grad_accum_steps={accum} does not divide "
+                f"batch_size={task.batch_size}"
+            )
 
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        def _grads_of(params, batch, r):
+            return jax.value_and_grad(
+                lambda p: task.loss_fn(p, batch, r), has_aux=True
+            )(params)
+
+        def _step(state: TrainState, batch, r):
+            if accum == 1:
+                (loss, aux), grads = _grads_of(state.params, batch, r)
+            else:
+                # batch leaves arrive [accum, B/accum, ...] (scalars pass
+                # through unstacked); scan the microbatches, summing
+                # grads/metrics in fp32 carries
+                def micro(i):
+                    return jax.tree_util.tree_map(
+                        lambda x: x if jnp.ndim(x) == 0 else x[i], batch
+                    )
+
+                f32 = lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32), t
+                )
+                (loss0, aux0), g0 = _grads_of(
+                    state.params, micro(0), jax.random.fold_in(r, 0)
+                )
+                carry0 = (loss0.astype(jnp.float32), f32(aux0), f32(g0))
+
+                def body(carry, i):
+                    loss_s, aux_s, g_s = carry
+                    (loss_i, aux_i), g_i = _grads_of(
+                        state.params, micro(i), jax.random.fold_in(r, i)
+                    )
+                    add32 = lambda a, b: a + b.astype(jnp.float32)
+                    return (
+                        loss_s + loss_i.astype(jnp.float32),
+                        jax.tree_util.tree_map(add32, aux_s, aux_i),
+                        jax.tree_util.tree_map(add32, g_s, g_i),
+                    ), None
+
+                (loss_sum, aux_sum, g_sum), _ = jax.lax.scan(
+                    body, carry0, jnp.arange(1, accum)
+                )
+                loss = loss_sum / accum
+                aux = jax.tree_util.tree_map(lambda a: a / accum, aux_sum)
+                # back to the params' native grad dtype for the optimizer
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / accum).astype(p.dtype), g_sum, state.params
+                )
             updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
@@ -176,10 +231,16 @@ class Trainer:
         )
 
     def _batch_shardings(self):
-        """Batch leaves shard dim 0 over data(+fsdp); scalars replicate.
-        Computed once in _build (synthesizes a throwaway example batch);
-        use the cached ``batch_shardings`` afterwards."""
-        example = self.task.make_batch(np.random.default_rng(0), self.task.batch_size)
+        """Batch leaves shard their batch dim over data(+fsdp); scalars
+        replicate. With gradient accumulation the batch dim is dim 1
+        (leaves are [accum, B/accum, ...], see prepare_batch) and the
+        accumulation dim stays unsharded. Computed once in _build
+        (synthesizes a throwaway example batch); use the cached
+        ``batch_shardings`` afterwards."""
+        example = self.prepare_batch(
+            self.task.make_batch(np.random.default_rng(0), self.task.batch_size)
+        )
+        accum = max(self.config.grad_accum_steps, 1)
 
         def one(leaf):
             arr = np.asarray(leaf)
@@ -190,12 +251,33 @@ class Trainer:
             )
             if not axes:
                 return NamedSharding(self.mesh, P())
+            spec = axes if len(axes) > 1 else axes[0]
+            if accum > 1:
+                return NamedSharding(
+                    self.mesh, P(None, spec, *([None] * (arr.ndim - 2)))
+                )
             return NamedSharding(
-                self.mesh, P(axes if len(axes) > 1 else axes[0], *([None] * (arr.ndim - 1)))
+                self.mesh, P(spec, *([None] * (arr.ndim - 1)))
             )
 
         self._example_batch = example
         return jax.tree_util.tree_map(one, example)
+
+    def prepare_batch(self, host_batch):
+        """Host-side shape adapter: with grad_accum_steps > 1, reshape
+        each [B, ...] leaf to [accum, B/accum, ...] (scalars pass
+        through) so the jitted step can scan microbatches."""
+        accum = max(self.config.grad_accum_steps, 1)
+        if accum == 1:
+            return host_batch
+
+        def one(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim == 0:
+                return arr
+            return arr.reshape(accum, arr.shape[0] // accum, *arr.shape[1:])
+
+        return jax.tree_util.tree_map(one, host_batch)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -243,7 +325,9 @@ class Trainer:
             if step == prof_start:
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
-            host_batch = self.task.make_batch(np_rng, self.task.batch_size)
+            host_batch = self.prepare_batch(
+                self.task.make_batch(np_rng, self.task.batch_size)
+            )
             batch = jax.device_put(host_batch, batch_shardings)
             state, metrics = self._step_fn(state, batch, jax.random.fold_in(jax.random.key(cfg.seed), step))
             if profiling and step + 1 >= prof_stop:
@@ -378,6 +462,7 @@ def run_task(
             seed=int(env.get("TFK8S_SEED", "0")),
             resume=ctx.resuming,
             profile_dir=env.get("TFK8S_PROFILE_DIR", ""),
+            grad_accum_steps=int(env.get("TFK8S_GRAD_ACCUM", "1")),
         )
 
     trainer = Trainer(task, config, mesh)
