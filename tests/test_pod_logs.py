@@ -139,7 +139,7 @@ def test_failed_pod_keeps_logs(cluster):
             for p in pods
         )
 
-    assert wait_for(failed_pod_with_tail, timeout=30)
+    assert wait_for(failed_pod_with_tail, timeout=90)  # slow under full-suite load
 
 
 def test_logs_cli_verb(tmp_path, capsys):

@@ -37,6 +37,15 @@ log = get_logger("kubelet")
 LOG_TAIL_LIMIT = 200
 LOG_FLUSH_SECONDS = 1.0
 
+# Node heartbeat (the k8s node-lease mechanism): the kubelet renews a
+# Lease named node-<name>; the controller marks a node's RUNNING pods
+# Failed(NodeLost) once the lease goes stale — without this, a dead node
+# agent strands its pods Running forever and the gang never recovers
+# (SURVEY.md §3.5 failure path; slice loss must become job restart).
+NODE_LEASE_PREFIX = "node-"
+NODE_LEASE_DURATION_S = 5.0
+NODE_LEASE_RENEW_S = 1.0
+
 
 class _PodLogRouter(logging.Handler):
     """Captures the ``tfk8s.*`` log records emitted by pod entrypoint
@@ -85,9 +94,17 @@ class _PodLogRouter(logging.Handler):
 class LocalKubelet:
     """Watches pods and runs their entrypoints on daemon threads."""
 
-    def __init__(self, clientset: Clientset, name: str = "local-kubelet"):
+    def __init__(
+        self,
+        clientset: Clientset,
+        name: str = "local-kubelet",
+        lease_duration_s: float = NODE_LEASE_DURATION_S,
+        lease_renew_s: float = NODE_LEASE_RENEW_S,
+    ):
         self.cs = clientset
         self.name = name
+        self.lease_duration_s = lease_duration_s
+        self.lease_renew_s = lease_renew_s
         self.informer = SharedIndexInformer(clientset.pods(namespace=None), name="kubelet-pod")
         self.informer.add_event_handler(
             ResourceEventHandler(
@@ -121,6 +138,48 @@ class LocalKubelet:
         threading.Thread(
             target=self._flush_logs_loop, name=f"{self.name}-logflush", daemon=True
         ).start()
+        threading.Thread(
+            target=self._heartbeat_loop, name=f"{self.name}-heartbeat", daemon=True
+        ).start()
+
+    # -- node heartbeat -----------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        """Renew this node's Lease until stopped. Best-effort: apiserver
+        flaps are logged and retried — the controller only acts once the
+        lease is STALE, so transient failures inside the lease duration
+        are invisible."""
+        import time
+
+        from tfk8s_tpu.api.types import Lease, LeaseSpec, ObjectMeta
+        from tfk8s_tpu.client.store import StoreError
+
+        leases = self.cs.generic("Lease", "default")
+        name = NODE_LEASE_PREFIX + self.name
+        while self._stop is not None and not self._stop.is_set():
+            now = time.time()
+            try:
+                try:
+                    lease = leases.get(name)
+                    lease.spec.holder = self.name
+                    lease.spec.lease_duration_s = self.lease_duration_s
+                    lease.spec.renew_time = now
+                    leases.update(lease)
+                except NotFound:
+                    leases.create(
+                        Lease(
+                            metadata=ObjectMeta(name=name, namespace="default"),
+                            spec=LeaseSpec(
+                                holder=self.name,
+                                lease_duration_s=self.lease_duration_s,
+                                acquire_time=now,
+                                renew_time=now,
+                            ),
+                        )
+                    )
+            except (StoreError, OSError) as e:
+                log.debug("%s: heartbeat failed: %s", self.name, e)
+            self._stop.wait(self.lease_renew_s)
 
     # -- pod log plumbing ---------------------------------------------------
 

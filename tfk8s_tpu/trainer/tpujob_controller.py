@@ -64,6 +64,16 @@ PENDING_REQUEUE_S = 0.5
 # _reconcile_replicas so an operator restart doesn't churn running gangs.
 _PLACEMENT_ENV_KEYS = frozenset({"TFK8S_SLICE_ID", "TFK8S_HOST_INDEX"})
 
+# Node-lost detection (k8s node-lease semantics): a RUNNING pod whose
+# node's heartbeat Lease (runtime/kubelet.py NODE_LEASE_PREFIX) has been
+# stale for GRACE x lease_duration is marked Failed(NodeLost), feeding
+# the ordinary failure path (gang restart-from-checkpoint). Nodes that
+# never wrote a lease are exempt — there is no liveness contract to
+# break. Jobs with running pods are re-checked every CHECK_PERIOD
+# because a dead node emits no events to wake the reconciler.
+NODE_LOST_GRACE = 2.0
+NODE_CHECK_PERIOD_S = 2.0
+
 
 def _contract_env(pod) -> dict:
     return {
@@ -274,6 +284,70 @@ class TPUJobController:
     def _observed_pods(self, job: TPUJob) -> List[Pod]:
         return self.pods.list(job.metadata.namespace, L.job_selector(job.metadata.name))
 
+    def _check_node_liveness(self, job: TPUJob, observed) -> None:
+        """Mark RUNNING pods on heartbeat-dead nodes Failed(NodeLost) —
+        k8s node-lease semantics (module constants above). A dead node
+        emits no pod events, so jobs with running pods are re-enqueued on
+        a short period to keep this check live."""
+        from tfk8s_tpu.runtime.kubelet import NODE_LEASE_PREFIX
+
+        key = job.metadata.key
+        ns = job.metadata.namespace
+        now = time.time()
+        running = [
+            p for p in observed.values()
+            if p.status.phase == PodPhase.RUNNING
+            and p.metadata.deletion_timestamp is None
+            and p.status.host
+        ]
+        # one Lease fetch per distinct HOST, not per pod — a gang's pods
+        # share few hosts and this path re-runs every CHECK_PERIOD
+        leases = self.cs.generic("Lease", "default")
+        stale_by_host: dict = {}
+        for host in {p.status.host for p in running}:
+            try:
+                lease = leases.get(NODE_LEASE_PREFIX + host)
+            except NotFound:
+                continue  # node never heartbeated; no liveness contract
+            rt = lease.spec.renew_time
+            if rt is None:
+                rt = lease.spec.acquire_time or 0.0
+            if now > rt + lease.spec.lease_duration_s * NODE_LOST_GRACE:
+                stale_by_host[host] = (now - rt, lease.spec.lease_duration_s)
+        for pod in running:
+            if pod.status.host not in stale_by_host:
+                continue
+            age, duration = stale_by_host[pod.status.host]
+            msg = (
+                f"NodeLost: node {pod.status.host} lease stale for "
+                f"{age:.1f}s (duration {duration}s)"
+            )
+            self.recorder.event("TPUJob", key, "NodeLost",
+                                f"{pod.metadata.name}: {msg}")
+            self.metrics.inc("tpujob.node_lost_pods")
+            for _ in range(3):
+                try:
+                    cur = self.cs.pods(ns).get(pod.metadata.name)
+                except NotFound:
+                    break
+                if (
+                    cur.metadata.uid != pod.metadata.uid
+                    or cur.status.phase != PodPhase.RUNNING
+                ):
+                    break
+                cur.status.phase = PodPhase.FAILED
+                cur.status.message = msg
+                cur.status.exit_code = None
+                try:
+                    self.cs.pods(ns).update_status(cur)
+                    break
+                except Conflict:
+                    continue
+                except NotFound:
+                    break
+        if running:
+            self.controller.enqueue_after(key, NODE_CHECK_PERIOD_S)
+
     def _reconcile_replicas(self, job: TPUJob, ga, status_changed: bool) -> None:
         ns, key = job.metadata.namespace, job.metadata.key
         # Never render from a stale restart count (informer cache may lag
@@ -286,6 +360,7 @@ class TPUJobController:
         desired_names = {p.metadata.name for p in desired_pods}
         desired_svc_names = {s.metadata.name for s in desired_svcs}
         observed = {p.metadata.name: p for p in self._observed_pods(job)}
+        self._check_node_liveness(job, observed)
         observed_svcs = {
             s.metadata.name
             for s in self.services.list(ns, L.job_selector(job.metadata.name))
