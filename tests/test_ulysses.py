@@ -167,6 +167,24 @@ def test_impl_selection_policy_errors():
         )
 
 
+def test_ulysses_composes_with_flash_kernel():
+    """The documented composition: Ulysses supplies the sequence
+    exchange, the Pallas flash kernel runs the per-device attention
+    (interpret mode off-TPU). Output must match the XLA reference."""
+    import functools
+
+    from tfk8s_tpu.ops.flash_attention import flash_attention
+
+    mesh = make_mesh(sequence=2)
+    q, k, v = _qkv(b=1, l=32, h=4, d=8)
+    uly = make_ulysses_attn_fn(
+        mesh, inner=functools.partial(flash_attention, block_q=16, block_k=16)
+    )
+    got = uly(q, k, v, causal=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
 def test_t5_task_for_mesh_ulysses_trains():
     """T5 long-context now has an SP path (Ulysses carries the decoder's
     key-padding masks; ring could not)."""
