@@ -11,6 +11,37 @@ from tfk8s_tpu.cmd.main import load_manifest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_operator_deployment_manifest_shape():
+    """manifests/operator.yaml (the GKE deployment of the operator, C1–C3
+    deployment shape) must stay parseable and reference the API group the
+    CRD installs."""
+    import yaml
+
+    docs = list(
+        yaml.safe_load_all(open(os.path.join(REPO, "manifests", "operator.yaml")))
+    )
+    kinds = {d["kind"] for d in docs}
+    assert {
+        "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+        "ConfigMap", "Service", "Deployment",
+    } <= kinds
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    assert any("tfk8s.dev" in r.get("apiGroups", []) for r in role["rules"])
+
+    deps = {d["metadata"]["name"]: d for d in docs if d["kind"] == "Deployment"}
+    op = deps["tpujob-operator"]
+    cmd = op["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--leader-elect" in cmd and op["spec"]["replicas"] >= 2
+    # HA is meaningless without a SHARED backend: the operator must point
+    # at the apiserver Service via the mounted kubeconfig
+    assert any(a.startswith("--kubeconfig") for a in cmd), cmd
+    assert "tfk8s-apiserver" in deps
+    svc = next(d for d in docs if d["kind"] == "Service")
+    assert svc["metadata"]["name"] == "tfk8s-apiserver"
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    assert "tfk8s-apiserver" in cm["data"]["kubeconfig.json"]
+
+
 def test_example_manifests_decode_default_validate():
     paths = sorted(glob.glob(os.path.join(REPO, "manifests", "examples", "*.yaml")))
     assert paths, "no example manifests found"
