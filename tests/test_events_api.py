@@ -1,0 +1,230 @@
+"""Events as API objects (k8s core/v1 Event parity): the operator's
+EventRecorder mirrors events into the cluster, aggregated per (object,
+reason); `describe` and `get --kind events` read them across the HTTP
+apiserver; job teardown garbage-collects them. Plus the new scale/apply
+CLI verbs."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import helpers, serde
+from tfk8s_tpu.api.types import (
+    ContainerSpec, JobConditionType, ObjectMeta, ReplicaSpec, ReplicaType,
+    RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
+)
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.utils.logging import EventRecorder
+
+from conftest import wait_for
+
+
+@registry.register("events.echo")
+def _echo(env):
+    pass
+
+
+@registry.register("events.block")
+def _block(env, stop):
+    stop.wait(15)
+
+
+def make_job(name, entrypoint="events.echo", workers=1):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ContainerSpec(entrypoint=entrypoint),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-4"),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+        ),
+    )
+
+
+def test_recorder_sink_aggregates_by_object_and_reason():
+    cs = FakeClientset()
+    rec = EventRecorder(sink=cs)
+    for i in range(3):
+        rec.event("TPUJob", "default/j1", "GangPending", f"try {i}")
+    rec.event("TPUJob", "default/j1", "JobCreated")
+    rec.event("TPUJob", "default/j2", "GangPending")
+    rec.flush()  # mirroring is async (event-mirror thread)
+
+    events, _ = cs.generic("Event", "default").list()
+    by_name = {e.metadata.name: e for e in events}
+    assert by_name["j1.gangpending"].count == 3
+    assert by_name["j1.gangpending"].message == "try 2"
+    assert by_name["j1.jobcreated"].count == 1
+    assert by_name["j2.gangpending"].count == 1
+    assert by_name["j1.gangpending"].first_timestamp <= by_name[
+        "j1.gangpending"
+    ].last_timestamp
+
+
+def test_job_lifecycle_mirrors_and_gcs_events():
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-4": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    try:
+        cs.tpujobs().create(make_job("evj"))
+
+        def succeeded():
+            try:
+                return helpers.has_condition(
+                    cs.tpujobs().get("evj").status, JobConditionType.SUCCEEDED
+                )
+            except NotFound:
+                return False
+
+        assert wait_for(succeeded)
+
+        def mirrored():
+            events, _ = cs.generic("Event", "default").list()
+            reasons = {
+                e.reason for e in events if e.involved_key == "default/evj"
+            }
+            # a fast job can finish before the controller ever observes
+            # the all-running state, so JobRunning is not guaranteed
+            return "JobCreated" in reasons and "JobSucceeded" in reasons
+
+        assert wait_for(mirrored)
+
+        cs.tpujobs().delete("evj")
+
+        def gone():
+            try:
+                cs.tpujobs().get("evj")
+                return False
+            except NotFound:
+                events, _ = cs.generic("Event", "default").list()
+                return not any(
+                    e.involved_key == "default/evj" for e in events
+                )
+
+        assert wait_for(gone), "job events were not garbage-collected"
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()
+
+
+@pytest.fixture
+def http_cluster(tmp_path):
+    """Apiserver + operator (controller & kubelet in-process against the
+    remote store) + kubeconfig — the full CLI-facing stack."""
+    from tfk8s_tpu.client.apiserver import APIServer
+    from tfk8s_tpu.client.clientset import Clientset
+    from tfk8s_tpu.client.remote import RemoteStore
+    from tfk8s_tpu.client.store import ClusterStore
+
+    server = APIServer(ClusterStore(), port=0)
+    server.serve_background()
+    kc = tmp_path / "kubeconfig.json"
+    kc.write_text(json.dumps({"server": server.url}))
+
+    cs = Clientset.new_for_config(RemoteStore(server.url))
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-4": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    try:
+        yield str(kc), cs
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()
+        server.shutdown()
+
+
+def test_describe_and_get_events_over_http(http_cluster, tmp_path, capsys):
+    from tfk8s_tpu.cmd.main import main
+
+    kc, cs = http_cluster
+    manifest = tmp_path / "job.json"
+    manifest.write_text(json.dumps(serde.to_dict(make_job("cli-ev"))))
+
+    assert main(["submit", "--kubeconfig", kc, "--file", str(manifest)]) == 0
+    capsys.readouterr()
+
+    def succeeded():
+        try:
+            return helpers.has_condition(
+                cs.tpujobs().get("cli-ev").status, JobConditionType.SUCCEEDED
+            )
+        except NotFound:
+            return False
+
+    assert wait_for(succeeded)
+
+    def describe_shows_events():
+        assert main(["describe", "--kubeconfig", kc, "cli-ev"]) == 0
+        out = capsys.readouterr().out
+        return "Events:" in out and (
+            "JobSucceeded" in out or "JobRunning" in out
+        )
+
+    assert wait_for(describe_shows_events, timeout=30)
+
+    assert main(["get", "--kubeconfig", kc, "--kind", "events"]) == 0
+    out = capsys.readouterr().out
+    assert "REASON" in out and "TPUJob/default/cli-ev" in out
+
+
+def test_scale_and_apply_verbs(http_cluster, tmp_path, capsys):
+    from tfk8s_tpu.cmd.main import main
+
+    kc, cs = http_cluster
+    job = make_job("sa", entrypoint="events.block", workers=1)
+    manifest = tmp_path / "sa.json"
+    manifest.write_text(json.dumps(serde.to_dict(job)))
+
+    # apply: create, then configure (idempotent re-apply with an edit)
+    assert main(["apply", "--kubeconfig", kc, "--file", str(manifest)]) == 0
+    assert "created" in capsys.readouterr().out
+
+    def running():
+        try:
+            return helpers.has_condition(
+                cs.tpujobs().get("sa").status, JobConditionType.RUNNING
+            )
+        except NotFound:
+            return False
+
+    assert wait_for(running)
+
+    job.spec.replica_specs[ReplicaType.WORKER].template.env = {"X": "1"}
+    manifest.write_text(json.dumps(serde.to_dict(job)))
+    assert main(["apply", "--kubeconfig", kc, "--file", str(manifest)]) == 0
+    assert "configured" in capsys.readouterr().out
+
+    # scale up through the verb; controller reconverges the gang
+    assert main([
+        "scale", "--kubeconfig", kc, "sa", "--replicas", "3",
+    ]) == 0
+    assert "scaled" in capsys.readouterr().out
+
+    from tfk8s_tpu.trainer import labels as L
+
+    def three_workers():
+        pods, _ = cs.pods().list(label_selector=L.job_selector("sa"))
+        live = [p for p in pods if p.metadata.deletion_timestamp is None]
+        return len(live) == 3
+
+    assert wait_for(three_workers, timeout=60)
+
+    # bad replica type is a clean error
+    assert main([
+        "scale", "--kubeconfig", kc, "sa", "--replicas", "1",
+        "--replica-type", "Banana",
+    ]) == 1
+    assert main(["delete", "--kubeconfig", kc, "sa"]) == 0

@@ -355,10 +355,32 @@ class Lease:
     kind: str = "Lease"
 
 
+@dataclass
+class Event:
+    """A control-plane event as an API OBJECT (k8s core/v1 Event parity):
+    the operator's EventRecorder mirrors its in-memory log into these so
+    any client — including `describe`/`get --kind events` across the
+    HTTP apiserver — can read a job's history without reaching into the
+    operator process. Aggregated k8s-style: one object per (involved
+    object, reason), bumping ``count``/``last_timestamp`` on repeats."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_key: str = ""  # namespace/name of the involved object
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_timestamp: Optional[float] = None
+    last_timestamp: Optional[float] = None
+    api_version: str = "core/v1"
+    kind: str = "Event"
+
+
 # All registerable top-level kinds, for the scheme (serde.py).
 TOP_LEVEL_KINDS = {
     "TPUJob": TPUJob,
     "Pod": Pod,
     "Service": Service,
     "Lease": Lease,
+    "Event": Event,
 }

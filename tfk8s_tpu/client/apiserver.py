@@ -65,6 +65,7 @@ PLURALS: Dict[str, str] = {
     "pods": "Pod",
     "services": "Service",
     "leases": "Lease",
+    "events": "Event",
 }
 KIND_TO_PLURAL = {v: k for k, v in PLURALS.items()}
 

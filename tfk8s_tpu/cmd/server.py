@@ -52,7 +52,7 @@ class Server:
             self.store, RESTConfig(qps=qps, burst=burst)
         )
         self.allocator = SliceAllocator(opts.capacity or None)
-        self.recorder = EventRecorder()
+        self.recorder = EventRecorder(sink=self.clientset)
         self.metrics = Metrics()
         self.controller = TPUJobController(
             self.clientset,

@@ -96,7 +96,10 @@ class TPUJobController:
     ):
         self.cs = clientset
         self.allocator = allocator or SliceAllocator()
-        self.recorder = recorder or EventRecorder()
+        # default recorder mirrors events into the cluster as Event
+        # objects (utils/logging.py EventRecorder sink) so `describe` /
+        # `get --kind events` work across the apiserver
+        self.recorder = recorder or EventRecorder(sink=clientset)
         self.metrics = metrics or Metrics()
 
         self.job_informer = SharedIndexInformer(
@@ -750,6 +753,22 @@ class TPUJobController:
             except NotFound:
                 pass
 
+    def _delete_job_events(self, job: TPUJob) -> None:
+        """Garbage-collect the job's mirrored Event objects (k8s expires
+        events by TTL; here deletion rides job teardown)."""
+        ns, key = job.metadata.namespace, job.metadata.key
+        try:
+            client = self.cs.generic("Event", ns)
+            events, _rv = client.list()
+            for ev in events:
+                if getattr(ev, "involved_key", "") == key:
+                    try:
+                        client.delete(ev.metadata.name)
+                    except NotFound:
+                        pass
+        except Exception as e:  # noqa: BLE001 — event GC is best-effort
+            log.debug("event GC for %s failed: %s", key, e)
+
     def _finalize(self, job: TPUJob) -> None:
         """Deletion path (SURVEY.md §3.4): tear everything down, then strip
         the finalizer so the store completes the delete."""
@@ -764,7 +783,14 @@ class TPUJobController:
             try:
                 self.cs.tpujobs(job.metadata.namespace).update(job)
             except Conflict:
+                # deletion NOT complete yet — retry without wiping the
+                # event history or recording a premature JobDeleted
                 self.controller.enqueue_key(key)
+                return
             except NotFound:
                 return
         self.recorder.event("TPUJob", key, "JobDeleted")
+        # AFTER the terminal event, so its mirrored object is GC'd too —
+        # a deleted job leaves no Event objects behind
+        self.recorder.flush()
+        self._delete_job_events(job)
