@@ -326,14 +326,20 @@ class LocalKubelet:
             )
         except Exception as e:  # noqa: BLE001 — container failure, not ours
             log.info("%s: pod %s failed: %s", self.name, key, e)
-            self._set_phase(
-                key,
-                uid,
-                PodPhase.FAILED,
-                message=f"{type(e).__name__}: {e}",
-                exit_code=1,
-                log_tail=list(buf),
-            )
+            try:
+                self._set_phase(
+                    key,
+                    uid,
+                    PodPhase.FAILED,
+                    message=f"{type(e).__name__}: {e}",
+                    exit_code=1,
+                    log_tail=list(buf),
+                )
+            except Exception:  # noqa: BLE001 — apiserver gone (teardown):
+                # the node lease will go stale and the controller (if any
+                # is left) marks the pod NodeLost; nothing more to do here
+                log.debug("%s: terminal status write for %s failed:\n%s",
+                          self.name, key, traceback.format_exc())
             log.debug("%s", traceback.format_exc())
         finally:
             self._log_router.unregister(ident)
