@@ -1,0 +1,162 @@
+"""Priority preemption: a higher-priority gang evicts the lowest-priority
+running gang of the same accelerator generation when admission fails;
+the victim's pods are deleted, its slices freed, its ``preemptions``
+counter bumps (resume-from-checkpoint contract, backoff_limit
+untouched), and it re-admits automatically when capacity frees. The
+reference has no scheduler at all (k8s Jobs admit pods independently,
+k8s-operator.md:44-49); this is the TPU-cluster reality on top of the
+gang allocator."""
+
+import threading
+
+import pytest
+
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.types import (
+    ContainerSpec, JobConditionType, ObjectMeta, PodPhase, ReplicaSpec,
+    ReplicaType, RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
+)
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer import labels as L
+
+from conftest import wait_for
+
+
+@registry.register("preempt.block")
+def _block(env, stop):
+    stop.wait(30)
+
+
+def make_job(name, priority=0):
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=4,
+                    template=ContainerSpec(entrypoint="preempt.block"),
+                )
+            },
+            tpu=TPUSpec(accelerator="v5litepod-16"),  # 4 hosts, 1 slice
+            run_policy=RunPolicy(
+                scheduling=SchedulingPolicy(gang=True, priority=priority)
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"v5litepod-16": 1}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, stop
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def running(cs, name):
+    def check():
+        try:
+            return helpers.has_condition(
+                cs.tpujobs().get(name).status, JobConditionType.RUNNING
+            )
+        except NotFound:
+            return False
+
+    return check
+
+
+def live_pods(cs, name):
+    pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+    return [p for p in pods if p.metadata.deletion_timestamp is None]
+
+
+def test_higher_priority_preempts_and_victim_resumes(cluster):
+    cs, ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("low", priority=1))
+    assert wait_for(running(cs, "low"))
+
+    cs.tpujobs().create(make_job("high", priority=10))
+    # high takes the slice; low is evicted
+    assert wait_for(running(cs, "high"), timeout=60)
+
+    def low_evicted():
+        j = cs.tpujobs().get("low")
+        return j.status.preemptions == 1 and not any(
+            p.status.phase == PodPhase.RUNNING for p in live_pods(cs, "low")
+        )
+
+    assert wait_for(low_evicted, timeout=60)
+    assert any(e.reason == "Preempted" for e in ctrl.recorder.events())
+    assert any(e.reason == "PreemptedOther" for e in ctrl.recorder.events())
+    # eviction is not failure: backoff budget untouched
+    assert cs.tpujobs().get("low").status.gang_restarts == 0
+
+    # capacity frees -> the victim re-admits and RESUMES (restart env > 0)
+    cs.tpujobs().delete("high")
+    assert wait_for(running(cs, "low"), timeout=60)
+    pods = live_pods(cs, "low")
+    assert pods, "victim never got pods back"
+    env = pods[0].spec.containers[0].env
+    assert env["TFK8S_GANG_RESTARTS"] == "1"  # preemption counts for resume
+
+
+def test_infeasible_demand_evicts_nobody(cluster):
+    """The livelock guard: a high-priority job whose demand can never be
+    satisfied (2 slices; pool owns 1) must not churn lower-priority
+    gangs — the allocator dry-run finds no feasible plan, so the victim
+    keeps running untouched."""
+    import json as _json
+    import time as _time
+
+    cs, ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("steady", priority=1))
+    assert wait_for(running(cs, "steady"))
+
+    giant = make_job("giant", priority=10)
+    giant.spec.tpu.num_slices = 2
+    giant.spec.replica_specs[ReplicaType.WORKER].replicas = 8
+    cs.tpujobs().create(giant)
+
+    assert wait_for(
+        lambda: any(e.reason == "GangPending" for e in ctrl.recorder.events())
+    )
+    _time.sleep(2)  # several admission retries
+    steady = cs.tpujobs().get("steady")
+    assert steady.status.preemptions == 0
+    assert len(live_pods(cs, "steady")) == 4
+    assert not any(e.reason == "Preempted" for e in ctrl.recorder.events())
+
+
+def test_equal_priority_never_preempts(cluster):
+    cs, ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("a", priority=5))
+    assert wait_for(running(cs, "a"))
+    cs.tpujobs().create(make_job("b", priority=5))
+
+    assert wait_for(
+        lambda: any(e.reason == "GangPending" for e in ctrl.recorder.events())
+    )
+    # a keeps its gang; b waits
+    assert cs.tpujobs().get("a").status.preemptions == 0
+    assert len(live_pods(cs, "a")) == 4
+    assert not helpers.has_condition(
+        cs.tpujobs().get("b").status, JobConditionType.RUNNING
+    )
+
+
+def test_zero_priority_job_cannot_preempt(cluster):
+    cs, ctrl, _stop = cluster
+    cs.tpujobs().create(make_job("base", priority=0))
+    assert wait_for(running(cs, "base"))
+    cs.tpujobs().create(make_job("also-zero", priority=0))
+    assert wait_for(
+        lambda: any(e.reason == "GangPending" for e in ctrl.recorder.events())
+    )
+    assert cs.tpujobs().get("base").status.preemptions == 0

@@ -254,6 +254,11 @@ class TPUJobStatus:
     completion_time: Optional[float] = None
     # Whole-gang restarts performed so far (counts against backoff_limit).
     gang_restarts: int = 0
+    # Times this job's gang was preempted by a higher-priority job. A
+    # preemption IS a gang restart for the resume contract (the recreated
+    # gang restores from checkpoint) but does NOT consume backoff_limit —
+    # being evicted is not a failure.
+    preemptions: int = 0
     # Checkpoint step the gang last persisted (resume point on restart).
     checkpoint_step: Optional[int] = None
 

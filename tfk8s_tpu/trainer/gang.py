@@ -322,6 +322,44 @@ class SliceAllocator:
         with self._lock:
             return self._assigned.get(job_uid)
 
+    def preemption_plan(
+        self, job: TPUJob, candidate_uids: List[str]
+    ) -> Optional[List[str]]:
+        """Dry-run (k8s-preemption style): the SHORTEST prefix of
+        ``candidate_uids`` (caller orders them cheapest-victim-first)
+        whose release would let ``job`` admit, or None when even evicting
+        all of them cannot help — the caller must then evict nobody
+        (evicting without a feasible plan would livelock the cluster:
+        victims churn forever while the job still never fits). Pure
+        simulation: every free-list mutation is rolled back before
+        returning."""
+        uid = job.metadata.uid
+        with self._lock:
+            info = topo.parse_accelerator(job.spec.tpu.accelerator, job.spec.tpu.topology)
+            want = max(job.spec.tpu.num_slices, 1)
+            snapshot = {
+                sid: list(free) for sid, (_ps, free) in self._slices.items()
+            }
+            try:
+                plan: List[str] = []
+                for vuid in candidate_uids:
+                    held = self._assigned.get(vuid)
+                    if held is None:
+                        continue
+                    for h in held.slices:
+                        self._release_handle(h)
+                    plan.append(vuid)
+                    ga = self._admit_locked(job, info, want, uid)
+                    if ga is not None:
+                        # trial carve mutated the free lists; the finally
+                        # block restores everything
+                        return plan
+                return None
+            finally:
+                for sid, boxes in snapshot.items():
+                    ps, _stale = self._slices[sid]
+                    self._slices[sid] = (ps, boxes)
+
     def release(self, job_uid: str) -> None:
         """Return a gang's boxes to the pool (job finished, deleted, or
         gang-restarting after slice loss)."""

@@ -70,7 +70,11 @@ def coordination_env(
         "TFK8S_NUM_SLICES": str(max(job.spec.tpu.num_slices, 1)),
         "TFK8S_SLICE_ID": slice_id,
         "TFK8S_HOST_INDEX": str(host_index),
-        "TFK8S_GANG_RESTARTS": str(job.status.gang_restarts),
+        # restarts + preemptions: either one means "this incarnation is a
+        # re-launch; restore from checkpoint" (launcher resume contract)
+        "TFK8S_GANG_RESTARTS": str(
+            job.status.gang_restarts + job.status.preemptions
+        ),
     }
     if job.spec.mesh is not None:
         env["TFK8S_MESH"] = json.dumps(job.spec.mesh.axes)

@@ -153,6 +153,8 @@ class TPUJobController:
         # pod rendered with the old TFK8S_GANG_RESTARTS would repeat the
         # pre-restart run and burn a second unit of backoff_limit
         self._gang_restarts_floor: dict = {}
+        # same stale-cache protection for the preemption counter
+        self._preemptions_floor: dict = {}
 
     def _enqueue_owner(self, obj) -> None:
         meta = getattr(obj, "obj", obj).metadata  # unwrap DeletedFinalStateUnknown
@@ -232,6 +234,8 @@ class TPUJobController:
 
         # Gang admission (SURVEY.md §7 hard part 1)
         ga = self.allocator.admit(job)
+        if ga is None and self._try_preempt(job):
+            ga = self.allocator.admit(job)
         self._export_capacity_gauges()
         if ga is None:
             self.recorder.event(
@@ -242,12 +246,17 @@ class TPUJobController:
             self.metrics.inc("tpujob.gang_pending")
             timeout = job.spec.run_policy.scheduling.admission_timeout_s
             created = helpers.get_condition(job.status, JobConditionType.CREATED)
-            # The timeout bounds INITIAL admission only. A running job can
-            # land here after a demand edit the pool can't satisfy (the
-            # allocator kept its old gang — gang.py admit); measuring that
-            # against job-creation time would insta-fail any long-running
-            # job on its first unsatisfiable scale request.
-            if helpers.has_condition(job.status, JobConditionType.RUNNING):
+            # The timeout bounds INITIAL admission only (never-started
+            # jobs). A job that already ran can land here after a demand
+            # edit the pool can't satisfy (allocator kept the old gang)
+            # or after being PREEMPTED (gang released, awaiting
+            # re-admission) — measuring either against job-creation time
+            # would insta-fail a long-running job.
+            if (
+                helpers.has_condition(job.status, JobConditionType.RUNNING)
+                or job.status.start_time is not None
+                or job.status.preemptions > 0
+            ):
                 self.controller.enqueue_after(key, PENDING_REQUEUE_S)
                 return
             if timeout and created and time.time() - created.last_transition_time > timeout:
@@ -286,6 +295,136 @@ class TPUJobController:
 
     def _observed_pods(self, job: TPUJob) -> List[Pod]:
         return self.pods.list(job.metadata.namespace, L.job_selector(job.metadata.name))
+
+    def _try_preempt(self, job: TPUJob) -> bool:
+        """Priority preemption: when admission fails, evict the cheapest
+        set of strictly-lower-priority same-generation gangs whose
+        release provably lets this job admit (allocator dry-run — no
+        feasible plan means NOBODY is evicted: evicting without one
+        would livelock the cluster, churning victims while the job still
+        never fits). Victims' pods are deleted and slices released
+        (k8s-preemption-style overlap: boxes free while pods drain);
+        each victim's ``preemptions`` counter bumps so its eventual
+        re-admission resumes from checkpoint without consuming
+        backoff_limit. Returns True when something was released."""
+        my_pri = job.spec.run_policy.scheduling.priority
+        if my_pri <= 0 or not job.spec.run_policy.scheduling.gang:
+            return False
+        from tfk8s_tpu.utils import topology as topo
+
+        try:
+            my_gen = topo.parse_accelerator(
+                job.spec.tpu.accelerator, job.spec.tpu.topology
+            ).generation
+        except topo.TopologyError:
+            return False
+        if my_gen == "cpu":
+            return False  # hermetic capacity is unlimited; nothing to evict
+
+        def victim_key(v: TPUJob):
+            # lowest priority first; among equals, youngest first (it has
+            # the least sunk work)
+            return (
+                v.spec.run_policy.scheduling.priority,
+                -(v.metadata.creation_timestamp or 0),
+            )
+
+        candidates = []
+        for v in self.jobs.list(None):
+            if v.metadata.uid == job.metadata.uid:
+                continue
+            if helpers.is_finished(v.status):
+                continue
+            if v.spec.run_policy.scheduling.priority >= my_pri:
+                continue
+            if self.allocator.assignment(v.metadata.uid) is None:
+                continue
+            try:
+                v_gen = topo.parse_accelerator(
+                    v.spec.tpu.accelerator, v.spec.tpu.topology
+                ).generation
+            except topo.TopologyError:
+                continue
+            if v_gen != my_gen:
+                continue
+            candidates.append(v)
+        if not candidates:
+            return False
+        ordered = sorted(candidates, key=victim_key)
+        plan = self.allocator.preemption_plan(
+            job, [v.metadata.uid for v in ordered]
+        )
+        if plan is None:
+            return False
+        victims = [v for v in ordered if v.metadata.uid in set(plan)]
+        evicted = False
+        for victim in victims:
+            if self._preempt_one(job, victim, my_pri):
+                evicted = True
+        return evicted
+
+    def _preempt_one(self, job: TPUJob, victim: TPUJob, my_pri: int) -> bool:
+        """Persist one victim's preemption, delete its pods, release its
+        gang. The status write re-validates the FRESH object — a victim
+        that finished (or was re-prioritized / released) between cache
+        read and write must not be resurrected: set_condition(RESTARTING)
+        would clear its terminal condition and re-run a completed job."""
+        vkey = victim.metadata.key
+        # Persist the preemption BEFORE deleting pods (same ordering
+        # rationale as the gang-restart flow): a conflict means a fresher
+        # sync owns the victim — re-read and re-validate.
+        for _ in range(3):
+            try:
+                fresh = self.cs.tpujobs(victim.metadata.namespace).get(
+                    victim.metadata.name
+                )
+            except NotFound:
+                return False
+            if (
+                helpers.is_finished(fresh.status)
+                or fresh.metadata.uid != victim.metadata.uid
+                or fresh.spec.run_policy.scheduling.priority >= my_pri
+                or self.allocator.assignment(fresh.metadata.uid) is None
+            ):
+                return False
+            fresh.status.preemptions += 1
+            helpers.set_condition(
+                fresh.status, JobConditionType.RESTARTING,
+                reason="Preempted",
+                message=(
+                    f"preemption {fresh.status.preemptions} by higher-"
+                    f"priority job {job.metadata.key} "
+                    f"(priority {my_pri} > "
+                    f"{fresh.spec.run_policy.scheduling.priority})"
+                ),
+            )
+            try:
+                self.cs.tpujobs(victim.metadata.namespace).update_status(fresh)
+                break
+            except Conflict:
+                continue
+            except NotFound:
+                return False
+        else:
+            return False
+        self._preemptions_floor[vkey] = fresh.status.preemptions
+        self.recorder.event(
+            "TPUJob", vkey, "Preempted",
+            f"by {job.metadata.key} (priority {my_pri})",
+        )
+        self.recorder.event(
+            "TPUJob", job.metadata.key, "PreemptedOther", vkey,
+        )
+        self.metrics.inc("tpujob.preemptions")
+        self._delete_job_pods(fresh, only_phases=None)
+        self.allocator.release(victim.metadata.uid)
+        self.controller.enqueue_key(vkey)  # victim re-queues for capacity
+        log.info(
+            "preempted %s (priority %d) for %s (priority %d)",
+            vkey, fresh.spec.run_policy.scheduling.priority,
+            job.metadata.key, my_pri,
+        )
+        return True
 
     def _check_node_liveness(self, job: TPUJob, observed) -> None:
         """Mark RUNNING pods on heartbeat-dead nodes Failed(NodeLost) —
@@ -359,6 +498,9 @@ class TPUJobController:
         floor = self._gang_restarts_floor.get(key, 0)
         if job.status.gang_restarts < floor:
             job.status.gang_restarts = floor
+        pfloor = self._preemptions_floor.get(key, 0)
+        if job.status.preemptions < pfloor:
+            job.status.preemptions = pfloor
         desired_pods, desired_svcs = R.render_all(job, ga)
         desired_names = {p.metadata.name for p in desired_pods}
         desired_svc_names = {s.metadata.name for s in desired_svcs}
@@ -603,11 +745,15 @@ class TPUJobController:
 
     def _prune_evaluator_failures(self, key: str) -> None:
         """Drop all controller-side memory for a deleted job (evaluator
-        failure dedup + gang-restart floor)."""
+        failure dedup + gang-restart/preemption floors) — a future job
+        reusing the name must not inherit a stale floor (it would render
+        TFK8S_GANG_RESTARTS > 0 and try to resume a checkpoint that
+        isn't its own)."""
         self._evaluator_failures_seen = {
             e for e in self._evaluator_failures_seen if e[0] != key
         }
         self._gang_restarts_floor.pop(key, None)
+        self._preemptions_floor.pop(key, None)
 
     def _delete_pod(self, ns: str, name: str) -> None:
         try:
