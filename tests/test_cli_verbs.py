@@ -141,6 +141,27 @@ def test_get_watch_streams_changes(cluster, tmp_path, capsys):
     assert "DELETED   watched" in out
 
 
+def test_suspend_resume_verbs_flip_the_flag(cluster, tmp_path, capsys):
+    from tfk8s_tpu.client.remote import RemoteStore
+
+    server, kc = cluster
+    manifest = write_manifest(tmp_path, name="parkme")
+    assert main(["submit", "--kubeconfig", kc, "--file", manifest]) == 0
+    capsys.readouterr()
+
+    assert main(["suspend", "--kubeconfig", kc, "parkme"]) == 0
+    assert "suspended" in capsys.readouterr().out
+    store = RemoteStore(server.url)
+    assert store.get("TPUJob", "default", "parkme").spec.run_policy.suspend
+
+    assert main(["suspend", "--kubeconfig", kc, "parkme"]) == 0
+    assert "already suspended" in capsys.readouterr().out
+
+    assert main(["resume", "--kubeconfig", kc, "parkme"]) == 0
+    assert "resumed" in capsys.readouterr().out
+    assert not store.get("TPUJob", "default", "parkme").spec.run_policy.suspend
+
+
 def test_user_errors_exit_1_not_traceback(cluster, tmp_path):
     _server, kc = cluster
     assert main(["get", "--kubeconfig", str(tmp_path / "nope.json")]) == 1

@@ -113,6 +113,7 @@ def set_condition(
     exclusive = {
         JobConditionType.RUNNING,
         JobConditionType.RESTARTING,
+        JobConditionType.SUSPENDED,
         JobConditionType.SUCCEEDED,
         JobConditionType.FAILED,
     }

@@ -118,6 +118,7 @@ class JobConditionType(str, enum.Enum):
     CREATED = "Created"
     RUNNING = "Running"
     RESTARTING = "Restarting"
+    SUSPENDED = "Suspended"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
 
@@ -213,6 +214,10 @@ class RunPolicy:
     active_deadline_seconds: Optional[float] = None
     # Whole-gang restarts-from-checkpoint before the job is failed.
     backoff_limit: Optional[int] = None
+    # Kueue-style suspend: True evicts the gang (pods deleted, slices
+    # returned to the pool) while keeping the job object; flipping back
+    # to False re-admits and resumes from checkpoint.
+    suspend: bool = False
     scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
 
 
@@ -254,10 +259,10 @@ class TPUJobStatus:
     completion_time: Optional[float] = None
     # Whole-gang restarts performed so far (counts against backoff_limit).
     gang_restarts: int = 0
-    # Times this job's gang was preempted by a higher-priority job. A
-    # preemption IS a gang restart for the resume contract (the recreated
-    # gang restores from checkpoint) but does NOT consume backoff_limit —
-    # being evicted is not a failure.
+    # Times this job's gang was evicted without failing: preempted by a
+    # higher-priority job, or suspended via RunPolicy.suspend. An
+    # eviction IS a gang restart for the resume contract (the recreated
+    # gang restores from checkpoint) but does NOT consume backoff_limit.
     preemptions: int = 0
     # Checkpoint step the gang last persisted (resume point on restart).
     checkpoint_step: Optional[int] = None

@@ -115,6 +115,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p_apply = kubectlish("apply", "create or update a TPUJob from a manifest")
     p_apply.add_argument("--file", required=True,
                          help="TPUJob manifest (YAML or JSON)")
+
+    p_sus = kubectlish("suspend", "evict a TPUJob's gang, freeing its slices")
+    p_sus.add_argument("name")
+    p_res = kubectlish("resume", "re-admit a suspended TPUJob (checkpoint resume)")
+    p_res.add_argument("name")
     return parser
 
 
@@ -502,6 +507,38 @@ def _cmd_scale(args: argparse.Namespace) -> int:
     return 1
 
 
+def _set_suspend(args: argparse.Namespace, value: bool) -> int:
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+    from tfk8s_tpu.client.store import Conflict
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    verb = "suspend" if value else "resume"
+    for _ in range(5):
+        job = cs.tpujobs(args.namespace).get(args.name)
+        if job.spec.run_policy.suspend == value:
+            print(f"tpujob {args.namespace}/{args.name} already "
+                  f"{'suspended' if value else 'running'}")
+            return 0
+        job.spec.run_policy.suspend = value
+        try:
+            cs.tpujobs(args.namespace).update(job)
+            print(f"tpujob {args.namespace}/{args.name} "
+                  f"{'suspended' if value else 'resumed'}")
+            return 0
+        except Conflict:
+            continue
+    log.error("%s: persistent write conflict; try again", verb)
+    return 1
+
+
+def _cmd_suspend(args: argparse.Namespace) -> int:
+    return _set_suspend(args, True)
+
+
+def _cmd_resume(args: argparse.Namespace) -> int:
+    return _set_suspend(args, False)
+
+
 def _cmd_apply(args: argparse.Namespace) -> int:
     """kubectl-apply parity: create the manifest's job, or update it in
     place when it already exists (spec replaced; status untouched)."""
@@ -584,7 +621,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         init_logging()
         return _cmd_kubelet(args)
     if args.command in (
-        "submit", "get", "describe", "delete", "logs", "scale", "apply"
+        "submit", "get", "describe", "delete", "logs", "scale", "apply",
+        "suspend", "resume",
     ):
         init_logging()
         handler = {
@@ -595,6 +633,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "logs": _cmd_logs,
             "scale": _cmd_scale,
             "apply": _cmd_apply,
+            "suspend": _cmd_suspend,
+            "resume": _cmd_resume,
         }[args.command]
         from tfk8s_tpu.client.store import StoreError
 

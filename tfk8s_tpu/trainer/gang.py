@@ -341,6 +341,14 @@ class SliceAllocator:
                 sid: list(free) for sid, (_ps, free) in self._slices.items()
             }
             try:
+                # the real admit() offers the preemptor's own held boxes
+                # back for a demand-changed re-carve; the dry run must do
+                # the same or a scale-up that needs its own boxes PLUS a
+                # victim's is judged infeasible (priority inversion)
+                held_self = self._assigned.get(uid)
+                if held_self is not None:
+                    for h in held_self.slices:
+                        self._release_handle(h)
                 plan: List[str] = []
                 for vuid in candidate_uids:
                     held = self._assigned.get(vuid)

@@ -232,6 +232,29 @@ class TPUJobController:
         if changed:
             self.recorder.event("TPUJob", key, "JobCreated")
 
+        # Kueue-style suspend (RunPolicy.suspend): evict the gang, free
+        # the slices, park the job until the flag clears — then the
+        # ordinary admission path below re-admits and the eviction
+        # counter makes the relaunched gang resume from checkpoint.
+        if job.spec.run_policy.suspend:
+            self._suspend(job)
+            return
+        sus = helpers.get_condition(job.status, JobConditionType.SUSPENDED)
+        if sus is not None and sus.status:
+            sus.status = False
+            # restart the admission clock: a job parked (possibly since
+            # birth) for days must not be insta-failed AdmissionTimeout
+            # measured against its CREATED transition
+            created_cond = helpers.get_condition(
+                job.status, JobConditionType.CREATED
+            )
+            if created_cond is not None:
+                created_cond.last_transition_time = time.time()
+            if self._write_status(job):
+                self.recorder.event("TPUJob", key, "JobResumed")
+            # fall through to ordinary admission: the eviction counter
+            # makes the relaunched gang resume from checkpoint
+
         # Gang admission (SURVEY.md §7 hard part 1)
         ga = self.allocator.admit(job)
         if ga is None and self._try_preempt(job):
@@ -295,6 +318,47 @@ class TPUJobController:
 
     def _observed_pods(self, job: TPUJob) -> List[Pod]:
         return self.pods.list(job.metadata.namespace, L.job_selector(job.metadata.name))
+
+    def _suspend(self, job: TPUJob) -> None:
+        """Evict a suspended job's gang (idempotent: re-syncs of an
+        already-suspended job are no-ops). The eviction bumps the same
+        counter preemption uses, so un-suspending resumes from
+        checkpoint without touching backoff_limit."""
+        key = job.metadata.key
+        if helpers.has_condition(job.status, JobConditionType.SUSPENDED):
+            # already parked; make sure stragglers are gone AND the gang
+            # is released (level-triggered: a transient failure between
+            # the first pass's status write and its release must not
+            # leak the slices for the park's duration — release is
+            # idempotent)
+            for pod in self._observed_pods(job):
+                if pod.metadata.deletion_timestamp is None:
+                    self._delete_pod(job.metadata.namespace, pod.metadata.name)
+            self.allocator.release(job.metadata.uid)
+            self._export_capacity_gauges()
+            return
+        live = [
+            p for p in self._observed_pods(job)
+            if p.metadata.deletion_timestamp is None
+        ]
+        had_gang = self.allocator.assignment(job.metadata.uid) is not None
+        # pods already draining from a prior eviction are NOT a live
+        # incarnation — counting them would inflate the resume lineage
+        if had_gang or live:
+            job.status.preemptions += 1
+            self._preemptions_floor[key] = job.status.preemptions
+        helpers.set_condition(
+            job.status, JobConditionType.SUSPENDED,
+            reason="JobSuspended",
+            message=f"suspension {job.status.preemptions} (RunPolicy.suspend)",
+        )
+        if not self._write_status(job):
+            return  # conflict: re-enqueued sync redoes the accounting
+        self.recorder.event("TPUJob", key, "JobSuspended")
+        self.metrics.inc("tpujob.suspensions")
+        self._delete_job_pods(job, only_phases=None)
+        self.allocator.release(job.metadata.uid)
+        self._export_capacity_gauges()
 
     def _try_preempt(self, job: TPUJob) -> bool:
         """Priority preemption: when admission fails, evict the cheapest
