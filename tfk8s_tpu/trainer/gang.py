@@ -241,16 +241,12 @@ class SliceAllocator:
             # Demand changed. Snapshot the free lists so a failed re-carve
             # restores the world exactly (the held boxes may be needed by,
             # or adjacent to, the new shape — release first, then carve).
-            snapshot = {
-                sid: list(free) for sid, (_ps, free) in self._slices.items()
-            }
+            snapshot = self._snapshot_free()
             for h in held.slices:
                 self._release_handle(h)
             ga = self._admit_locked(job, info, want, uid)
             if ga is None:
-                for sid, boxes in snapshot.items():
-                    ps, _stale = self._slices[sid]
-                    self._slices[sid] = (ps, boxes)
+                self._restore_free(snapshot)
                 log.debug(
                     "job uid=%s demand change unsatisfiable; keeping old gang",
                     uid,
@@ -294,6 +290,17 @@ class SliceAllocator:
             handles.append(h)
         return GangAssignment(uid, handles, hosts_per_slice=info.hosts)
 
+    def _snapshot_free(self) -> Dict[str, List[Box]]:
+        """Copy of every slice's free list (caller holds the lock) — the
+        one rollback mechanism shared by admit / admit_with_preemption /
+        preemption_plan."""
+        return {sid: list(free) for sid, (_ps, free) in self._slices.items()}
+
+    def _restore_free(self, snapshot: Dict[str, List[Box]]) -> None:
+        for sid, boxes in snapshot.items():
+            ps, _stale = self._slices[sid]
+            self._slices[sid] = (ps, boxes)
+
     def _release_handle(self, h: SliceHandle) -> None:
         if h.physical is None or h.box is None:
             return
@@ -322,6 +329,48 @@ class SliceAllocator:
         with self._lock:
             return self._assigned.get(job_uid)
 
+    def admit_with_preemption(
+        self, job: TPUJob, victim_uids: List[str]
+    ) -> Optional[GangAssignment]:
+        """Atomically release ``victim_uids``' gangs and admit ``job``
+        into the freed capacity — under ONE lock, so no other job (least
+        of all a victim's own concurrent sync re-admitting itself) can
+        slip into the window between release and carve. On failure the
+        victims' assignments and the free lists are restored intact."""
+        uid = job.metadata.uid
+        with self._lock:
+            info = topo.parse_accelerator(job.spec.tpu.accelerator, job.spec.tpu.topology)
+            want = max(job.spec.tpu.num_slices, 1)
+            snapshot_free = self._snapshot_free()
+            snapshot_assigned = {
+                v: self._assigned.get(v) for v in victim_uids
+            }
+            for v in victim_uids:
+                ga_v = self._assigned.pop(v, None)
+                if ga_v is not None:
+                    for h in ga_v.slices:
+                        self._release_handle(h)
+            held = self._assigned.pop(uid, None)  # demand-changed re-carve
+            if held is not None:
+                for h in held.slices:
+                    self._release_handle(h)
+            ga = self._admit_locked(job, info, want, uid)
+            if ga is None:
+                self._restore_free(snapshot_free)
+                for v, a in snapshot_assigned.items():
+                    if a is not None:
+                        self._assigned[v] = a
+                if held is not None:
+                    self._assigned[uid] = held
+                return None
+            self._assigned[uid] = ga
+            self.version += 1
+            log.info(
+                "admitted job uid=%s onto %s, preempting %s",
+                uid, [h.slice_id for h in ga.slices], victim_uids,
+            )
+            return ga
+
     def preemption_plan(
         self, job: TPUJob, candidate_uids: List[str]
     ) -> Optional[List[str]]:
@@ -337,9 +386,7 @@ class SliceAllocator:
         with self._lock:
             info = topo.parse_accelerator(job.spec.tpu.accelerator, job.spec.tpu.topology)
             want = max(job.spec.tpu.num_slices, 1)
-            snapshot = {
-                sid: list(free) for sid, (_ps, free) in self._slices.items()
-            }
+            snapshot = self._snapshot_free()
             try:
                 # the real admit() offers the preemptor's own held boxes
                 # back for a demand-changed re-carve; the dry run must do
@@ -364,9 +411,7 @@ class SliceAllocator:
                         return plan
                 return None
             finally:
-                for sid, boxes in snapshot.items():
-                    ps, _stale = self._slices[sid]
-                    self._slices[sid] = (ps, boxes)
+                self._restore_free(snapshot)
 
     def release(self, job_uid: str) -> None:
         """Return a gang's boxes to the pool (job finished, deleted, or

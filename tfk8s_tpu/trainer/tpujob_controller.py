@@ -257,8 +257,8 @@ class TPUJobController:
 
         # Gang admission (SURVEY.md §7 hard part 1)
         ga = self.allocator.admit(job)
-        if ga is None and self._try_preempt(job):
-            ga = self.allocator.admit(job)
+        if ga is None:
+            ga = self._try_preempt(job)
         self._export_capacity_gauges()
         if ga is None:
             self.recorder.event(
@@ -352,6 +352,10 @@ class TPUJobController:
             reason="JobSuspended",
             message=f"suspension {job.status.preemptions} (RunPolicy.suspend)",
         )
+        # pause the active-deadline clock (kueue semantics: suspend
+        # resets startTime) — parked hours must not count against
+        # active_deadline_seconds; re-admission restamps it
+        job.status.start_time = None
         if not self._write_status(job):
             return  # conflict: re-enqueued sync redoes the accounting
         self.recorder.event("TPUJob", key, "JobSuspended")
@@ -360,20 +364,23 @@ class TPUJobController:
         self.allocator.release(job.metadata.uid)
         self._export_capacity_gauges()
 
-    def _try_preempt(self, job: TPUJob) -> bool:
+    def _try_preempt(self, job: TPUJob):
         """Priority preemption: when admission fails, evict the cheapest
         set of strictly-lower-priority same-generation gangs whose
         release provably lets this job admit (allocator dry-run — no
         feasible plan means NOBODY is evicted: evicting without one
         would livelock the cluster, churning victims while the job still
-        never fits). Victims' pods are deleted and slices released
-        (k8s-preemption-style overlap: boxes free while pods drain);
-        each victim's ``preemptions`` counter bumps so its eventual
-        re-admission resumes from checkpoint without consuming
-        backoff_limit. Returns True when something was released."""
+        never fits). The release-and-admit is ONE atomic allocator
+        operation — a victim's own concurrent sync must not re-admit
+        itself into the freed capacity ahead of the preemptor (priority
+        inversion that would force a second eviction). Each victim's
+        ``preemptions`` counter bumps so its eventual re-admission
+        resumes from checkpoint without consuming backoff_limit; victim
+        pods drain after the swap (k8s-style grace overlap). Returns the
+        preemptor's GangAssignment, or None."""
         my_pri = job.spec.run_policy.scheduling.priority
         if my_pri <= 0 or not job.spec.run_policy.scheduling.gang:
-            return False
+            return None
         from tfk8s_tpu.utils import topology as topo
 
         try:
@@ -381,9 +388,9 @@ class TPUJobController:
                 job.spec.tpu.accelerator, job.spec.tpu.topology
             ).generation
         except topo.TopologyError:
-            return False
+            return None
         if my_gen == "cpu":
-            return False  # hermetic capacity is unlimited; nothing to evict
+            return None  # hermetic capacity is unlimited; nothing to evict
 
         def victim_key(v: TPUJob):
             # lowest priority first; among equals, youngest first (it has
@@ -413,30 +420,52 @@ class TPUJobController:
                 continue
             candidates.append(v)
         if not candidates:
-            return False
+            return None
         ordered = sorted(candidates, key=victim_key)
         plan = self.allocator.preemption_plan(
             job, [v.metadata.uid for v in ordered]
         )
         if plan is None:
-            return False
+            return None
         victims = [v for v in ordered if v.metadata.uid in set(plan)]
-        evicted = False
+        # 1) atomic swap FIRST: victims' boxes -> preemptor's gang. On
+        #    failure (a victim finished/released between plan and swap,
+        #    shrinking the freed capacity) NOTHING happened — no status
+        #    writes to roll back, no pods deleted for no benefit.
+        ga = self.allocator.admit_with_preemption(
+            job, [v.metadata.uid for v in victims]
+        )
+        if ga is None:
+            return None
+        # 2) persist each victim's eviction (checkpoint-resume contract)
+        #    and drain its pods; its next sync re-queues it for capacity
         for victim in victims:
-            if self._preempt_one(job, victim, my_pri):
-                evicted = True
-        return evicted
+            if not self._persist_preemption(job, victim, my_pri):
+                # narrow double-fault window (finished in the race, or
+                # persistent write conflict): the eviction stands — log
+                # so the missing resume counter is diagnosable
+                log.warning(
+                    "preempted %s but could not persist its eviction "
+                    "counter", victim.metadata.key,
+                )
+            self._delete_job_pods(victim, only_phases=None)
+            self.controller.enqueue_key(victim.metadata.key)
+            log.info(
+                "preempted %s (priority %d) for %s (priority %d)",
+                victim.metadata.key,
+                victim.spec.run_policy.scheduling.priority,
+                job.metadata.key, my_pri,
+            )
+        return ga
 
-    def _preempt_one(self, job: TPUJob, victim: TPUJob, my_pri: int) -> bool:
-        """Persist one victim's preemption, delete its pods, release its
-        gang. The status write re-validates the FRESH object — a victim
-        that finished (or was re-prioritized / released) between cache
-        read and write must not be resurrected: set_condition(RESTARTING)
-        would clear its terminal condition and re-run a completed job."""
+    def _persist_preemption(self, job: TPUJob, victim: TPUJob, my_pri: int) -> bool:
+        """Persist one victim's preemption (status counter + condition +
+        events). Runs AFTER the atomic swap, so it does not check the
+        allocator; but the status write still re-validates the FRESH
+        object — a victim that finished in the race window must not be
+        resurrected: set_condition(RESTARTING) would clear its terminal
+        condition and re-run a completed job."""
         vkey = victim.metadata.key
-        # Persist the preemption BEFORE deleting pods (same ordering
-        # rationale as the gang-restart flow): a conflict means a fresher
-        # sync owns the victim — re-read and re-validate.
         for _ in range(3):
             try:
                 fresh = self.cs.tpujobs(victim.metadata.namespace).get(
@@ -448,7 +477,6 @@ class TPUJobController:
                 helpers.is_finished(fresh.status)
                 or fresh.metadata.uid != victim.metadata.uid
                 or fresh.spec.run_policy.scheduling.priority >= my_pri
-                or self.allocator.assignment(fresh.metadata.uid) is None
             ):
                 return False
             fresh.status.preemptions += 1
@@ -480,14 +508,6 @@ class TPUJobController:
             "TPUJob", job.metadata.key, "PreemptedOther", vkey,
         )
         self.metrics.inc("tpujob.preemptions")
-        self._delete_job_pods(fresh, only_phases=None)
-        self.allocator.release(victim.metadata.uid)
-        self.controller.enqueue_key(vkey)  # victim re-queues for capacity
-        log.info(
-            "preempted %s (priority %d) for %s (priority %d)",
-            vkey, fresh.spec.run_policy.scheduling.priority,
-            job.metadata.key, my_pri,
-        )
         return True
 
     def _check_node_liveness(self, job: TPUJob, observed) -> None:
@@ -691,6 +711,35 @@ class TPUJobController:
 
         if gang_mode and gang_failed:
             failed = gang_failed  # evaluators don't drive gang accounting
+            # Idempotent accounting FIRST — before the limit check: if a
+            # sync re-observes failed pods whose episode was already
+            # counted (a crash or stale cache between the status write
+            # and pod deletion), it must neither burn a second unit of
+            # backoff_limit NOR terminate the job — a stale observation
+            # arriving after the final counted restart would otherwise
+            # fire BackoffLimitExceeded before the last incarnation ever
+            # ran (and, its pods already deleted, leave nothing behind).
+            # Keyed by pod UID (not name): recreated pods reuse names but
+            # get fresh UIDs, so a genuine repeat failure is a new
+            # episode and still counts.
+            failed_ids = sorted(
+                f"{p.metadata.name}:{p.metadata.uid[:8]}" for p in failed
+            )
+            existing = helpers.get_condition(
+                job.status, JobConditionType.RESTARTING
+            )
+            # Deliberately ignore existing.status: a stale Failed-pod
+            # event can arrive AFTER the restarted gang went Running
+            # (which flips RESTARTING to False) — the failed set's UIDs,
+            # baked into the message, are the episode's real identity.
+            already_counted = (
+                existing is not None
+                and existing.message
+                == self._gang_restart_message(job.status.gang_restarts, failed_ids)
+            )
+            if already_counted:
+                self._delete_job_pods(job, only_phases=None)
+                return True
             # Slice loss is gang loss: restart everything from checkpoint
             # (SURVEY.md §2 'Elastic / gang semantics').
             limit = job.spec.run_policy.backoff_limit or 0
@@ -703,53 +752,28 @@ class TPUJobController:
                 self.recorder.event("TPUJob", key, "BackoffLimitExceeded")
                 self._write_status(job)
                 return True
-            # Idempotent accounting: if a crash landed between the status
-            # write and pod deletion, the next sync re-observes the same
-            # failed pods with the RESTARTING condition already recorded —
-            # don't burn a second unit of backoff_limit, just finish the
-            # deletion. Keyed by pod UID (not name): recreated pods reuse
-            # names but get fresh UIDs, so a genuine repeat failure is a
-            # new episode and still counts against backoff_limit.
-            failed_ids = sorted(
-                f"{p.metadata.name}:{p.metadata.uid[:8]}" for p in failed
+            job.status.gang_restarts += 1
+            helpers.set_condition(
+                job.status, JobConditionType.RESTARTING,
+                reason="GangRestart",
+                message=self._gang_restart_message(
+                    job.status.gang_restarts, failed_ids
+                ),
             )
-            existing = helpers.get_condition(
-                job.status, JobConditionType.RESTARTING
+            # Persist the restart count BEFORE deleting pods: if this
+            # write conflicts, stop here — the failed pods are still
+            # observable, so the re-enqueued sync redoes the accounting.
+            # Deleting first would lose the increment on conflict
+            # (restart without trace).
+            if not self._write_status(job):
+                return True
+            # Floor for stale-cache syncs: the recreate pass must
+            # never render pods with a pre-increment restart count.
+            self._gang_restarts_floor[key] = job.status.gang_restarts
+            self.recorder.event(
+                "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
             )
-            # Deliberately ignore existing.status: a stale Failed-pod
-            # event can arrive AFTER the restarted gang went Running
-            # (which flips RESTARTING to False) — the failed set's UIDs,
-            # baked into the message, are the episode's real identity.
-            # Recreated pods get fresh UIDs, so a genuine second failure
-            # still produces a new message and is counted.
-            already_counted = (
-                existing is not None
-                and existing.message
-                == self._gang_restart_message(job.status.gang_restarts, failed_ids)
-            )
-            if not already_counted:
-                job.status.gang_restarts += 1
-                helpers.set_condition(
-                    job.status, JobConditionType.RESTARTING,
-                    reason="GangRestart",
-                    message=self._gang_restart_message(
-                        job.status.gang_restarts, failed_ids
-                    ),
-                )
-                # Persist the restart count BEFORE deleting pods: if this
-                # write conflicts, stop here — the failed pods are still
-                # observable, so the re-enqueued sync redoes the accounting.
-                # Deleting first would lose the increment on conflict
-                # (restart without trace).
-                if not self._write_status(job):
-                    return True
-                # Floor for stale-cache syncs: the recreate pass must
-                # never render pods with a pre-increment restart count.
-                self._gang_restarts_floor[key] = job.status.gang_restarts
-                self.recorder.event(
-                    "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
-                )
-                self.metrics.inc("tpujob.gang_restarts")
+            self.metrics.inc("tpujob.gang_restarts")
             self._delete_job_pods(job, only_phases=None)
             return True
 
