@@ -142,6 +142,56 @@ def test_failed_pod_keeps_logs(cluster):
     assert wait_for(failed_pod_with_tail, timeout=90)  # slow under full-suite load
 
 
+def test_logs_follow_streams_new_lines(tmp_path, capsys):
+    """`logs -f`: new tail lines stream as the pod writes them; the
+    stream ends when the pod goes terminal."""
+    from tfk8s_tpu.api.types import ContainerSpec as CS, Pod, PodSpec, PodStatus
+    from tfk8s_tpu.client.apiserver import APIServer
+    from tfk8s_tpu.client.clientset import Clientset
+    from tfk8s_tpu.client.store import ClusterStore
+    from tfk8s_tpu.cmd.main import main
+
+    store = ClusterStore()
+    server = APIServer(store, port=0)
+    server.serve_background()
+    kc = tmp_path / "kubeconfig.json"
+    kc.write_text(json.dumps({"server": server.url}))
+    cs = Clientset(store)
+    try:
+        cs.pods().create(
+            Pod(
+                metadata=ObjectMeta(name="fpod"),
+                spec=PodSpec(containers=[CS(entrypoint="x:y")]),
+                status=PodStatus(phase=PodPhase.RUNNING, log_tail=["line-1"]),
+            )
+        )
+        rc = {}
+        t = threading.Thread(
+            target=lambda: rc.setdefault(
+                "v",
+                main(["logs", "--kubeconfig", str(kc), "fpod",
+                      "-f", "--follow-timeout", "15"]),
+            )
+        )
+        t.start()
+        time.sleep(1.2)
+        p = cs.pods().get("fpod")
+        p.status.log_tail = ["line-1", "line-2"]
+        cs.pods().update_status(p)
+        time.sleep(1.2)
+        p = cs.pods().get("fpod")
+        p.status.log_tail = ["line-1", "line-2", "line-3"]
+        p.status.phase = PodPhase.SUCCEEDED  # terminal -> stream ends
+        cs.pods().update_status(p)
+        t.join(timeout=20)
+        assert not t.is_alive() and rc["v"] == 0
+        out = capsys.readouterr().out
+        assert out.count("line-1") == 1  # no re-prints
+        assert "line-2" in out and "line-3" in out
+    finally:
+        server.shutdown()
+
+
 def test_logs_cli_verb(tmp_path, capsys):
     """`logs POD` and `logs --job JOB` over the remote apiserver."""
     from tfk8s_tpu.api.types import Pod, PodSpec, PodStatus

@@ -92,6 +92,8 @@ def test_suspend_frees_capacity_and_resume_restores(cluster):
     j = cs.tpujobs().get("s1")
     assert j.status.preemptions == 1
     assert j.status.gang_restarts == 0  # eviction is not failure
+    # the active-deadline clock is paused while parked (kueue semantics)
+    assert j.status.start_time is None
 
     # freed capacity is genuinely usable: another job runs meanwhile
     cs.tpujobs().create(make_job("filler"))

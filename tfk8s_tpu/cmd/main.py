@@ -105,6 +105,12 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="pod name (omit with --job to dump the whole job)")
     p_logs.add_argument("--job", default="",
                         help="print logs for every pod of this TPUJob")
+    p_logs.add_argument("-f", "--follow", action="store_true",
+                        help="stream new lines until the pod terminates "
+                        "(single-pod form only)")
+    p_logs.add_argument("--follow-timeout", type=float, default=0.0,
+                        help="stop following after N seconds (0 = until "
+                        "the pod terminates)")
 
     p_scale = kubectlish("scale", "change a TPUJob's replica count")
     p_scale.add_argument("name")
@@ -591,6 +597,9 @@ def _cmd_logs(args: argparse.Namespace) -> int:
     if bool(args.name) == bool(args.job):
         log.error("logs: pass exactly one of POD_NAME or --job JOB")
         return 1
+    if getattr(args, "follow", False) and args.job:
+        log.error("logs: --follow works with a single POD_NAME")
+        return 1
     if args.name:
         pods = [cs.pods(args.namespace).get(args.name)]
     else:
@@ -606,6 +615,47 @@ def _cmd_logs(args: argparse.Namespace) -> int:
                   f"({pod.status.phase.value}) <==")
         for line in pod.status.log_tail:
             print(line)
+    if getattr(args, "follow", False):
+        return _follow_logs(cs, args, pods[0].status.log_tail)
+    return 0
+
+
+def _follow_logs(cs, args: argparse.Namespace, printed) -> int:
+    """`kubectl logs -f` parity: poll the pod's bounded status.log_tail
+    and print what's new. The tail is a rolling window, so new output is
+    aligned by the largest overlap between the old tail's end and the
+    new tail's start; a window that rotated entirely between polls
+    prints whole (lines older than the window are gone by design)."""
+    import time as _time
+
+    from tfk8s_tpu.api.types import PodPhase
+    from tfk8s_tpu.client.store import NotFound as _NotFound
+
+    last = list(printed)
+    deadline = (
+        _time.time() + args.follow_timeout if args.follow_timeout else None
+    )
+    try:
+        while deadline is None or _time.time() < deadline:
+            _time.sleep(0.5)
+            try:
+                pod = cs.pods(args.namespace).get(args.name)
+            except _NotFound:
+                return 0  # pod deleted; stream over
+            tail = pod.status.log_tail
+            if tail != last:
+                start = 0
+                for k in range(min(len(last), len(tail)), 0, -1):
+                    if last[-k:] == tail[:k]:
+                        start = k
+                        break
+                for line in tail[start:]:
+                    print(line, flush=True)
+                last = list(tail)
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                return 0
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
