@@ -222,8 +222,16 @@ class TestRemoteCRUD:
         doc = json.loads(
             urllib.request.urlopen(server.url + "/apis", timeout=5).read()
         )
-        assert doc["group_version"] == API_VERSION
-        assert "tpujobs" in doc["resources"]
+        # k8s discovery: APIGroupList at /apis, APIResourceList at the gv
+        # root (tests/test_wire_conformance.py pins the full shape)
+        assert doc["kind"] == "APIGroupList"
+        assert doc["groups"][0]["preferredVersion"]["groupVersion"] == API_VERSION
+        res = json.loads(
+            urllib.request.urlopen(
+                server.url + f"/apis/{API_VERSION}", timeout=5
+            ).read()
+        )
+        assert "tpujobs" in {r["name"] for r in res["resources"]}
 
 
 class TestRemoteWatch:

@@ -63,7 +63,7 @@ def test_submit_get_describe_delete_roundtrip(cluster, tmp_path, capsys):
 
     assert main(["describe", "--kubeconfig", kc, "cli-job"]) == 0
     detail = json.loads(capsys.readouterr().out)
-    assert detail["spec"]["replica_specs"]["Worker"]["replicas"] == 1
+    assert detail["spec"]["replicaSpecs"]["Worker"]["replicas"] == 1
 
     assert main(["delete", "--kubeconfig", kc, "cli-job"]) == 0
     assert "deleted" in capsys.readouterr().out

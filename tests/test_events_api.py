@@ -60,12 +60,12 @@ def test_recorder_sink_aggregates_by_object_and_reason():
 
     events, _ = cs.generic("Event", "default").list()
     by_name = {e.metadata.name: e for e in events}
-    assert by_name["j1.gangpending"].count == 3
-    assert by_name["j1.gangpending"].message == "try 2"
-    assert by_name["j1.jobcreated"].count == 1
-    assert by_name["j2.gangpending"].count == 1
-    assert by_name["j1.gangpending"].first_timestamp <= by_name[
-        "j1.gangpending"
+    assert by_name["tpujob.j1.gangpending"].count == 3
+    assert by_name["tpujob.j1.gangpending"].message == "try 2"
+    assert by_name["tpujob.j1.jobcreated"].count == 1
+    assert by_name["tpujob.j2.gangpending"].count == 1
+    assert by_name["tpujob.j1.gangpending"].first_timestamp <= by_name[
+        "tpujob.j1.gangpending"
     ].last_timestamp
 
 

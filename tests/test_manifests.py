@@ -26,7 +26,9 @@ def test_operator_deployment_manifest_shape():
         "ConfigMap", "Service", "Deployment",
     } <= kinds
     role = next(d for d in docs if d["kind"] == "ClusterRole")
-    assert any("tfk8s.dev" in r.get("apiGroups", []) for r in role["rules"])
+    from tfk8s_tpu import GROUP
+
+    assert any(GROUP in r.get("apiGroups", []) for r in role["rules"])
 
     deps = {d["metadata"]["name"]: d for d in docs if d["kind"] == "Deployment"}
     op = deps["tpujob-operator"]

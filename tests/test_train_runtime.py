@@ -174,3 +174,43 @@ def test_mnist_tpujob_end_to_end():
     assert succeeded, "MNIST job did not converge within deadline"
     stop.set()
     ctrl.controller.shutdown()
+
+
+def test_prefetch_pipeline_matches_synchronous_fit():
+    """The background input pipeline (TrainConfig.prefetch, VERDICT r2
+    next #3) must be a pure overlap optimization: identical batch order,
+    identical rng stream, bit-identical training trajectory."""
+    mesh = make_mesh(data=8)
+    task = mlp.make_task()
+    histories = []
+    for prefetch in (0, 2):
+        cfg = TrainConfig(
+            steps=6, learning_rate=1e-2, log_every=1, seed=7,
+            prefetch=prefetch,
+        )
+        _state, hist = Trainer(task, cfg, mesh).fit()
+        histories.append([(h["step"], h["loss"]) for h in hist])
+    assert histories[0] == histories[1]
+
+
+def test_prefetch_producer_error_surfaces_in_fit():
+    """A poisoned input pipeline must fail the step loop loudly (a failed
+    pod is how the control plane learns), not hang the consumer."""
+    mesh = make_mesh(data=8)
+    task = mlp.make_task()
+    calls = {"n": 0}
+    orig = task.make_batch
+
+    def bad_make_batch(rng, batch_size):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise ValueError("injected input-pipeline failure")
+        return orig(rng, batch_size)
+
+    import dataclasses as _dc
+
+    bad_task = _dc.replace(task, make_batch=bad_make_batch)
+    with pytest.raises(ValueError, match="injected input-pipeline"):
+        Trainer(
+            bad_task, TrainConfig(steps=8, log_every=1, prefetch=2), mesh
+        ).fit()

@@ -1,0 +1,276 @@
+"""Kubernetes wire-format conformance (VERDICT r2 missing #1 / next #6).
+
+The reference's client stack is real k8s machinery: REST at
+``/apis/<group>/<version>/namespaces/*/<plural>`` (k8s-operator.md:33-34),
+``setConfigDefaults`` with ``APIPath="/apis"`` and a codec factory
+(images/tf5-tf6 per SURVEY.md). These tests pin OUR wire to the same
+conventions a client-go-shaped tool expects:
+
+- camelCase keys from dataclass field names; map keys (labels, replica
+  types) verbatim;
+- ``apiVersion``/``kind`` envelope on every object;
+- ``metadata.resourceVersion`` as an opaque string;
+- ``*List`` envelopes with ``metadata.resourceVersion``;
+- watch events as ``{"type", "object"}`` with the object in wire form;
+- errors as ``metav1.Status`` (``status: Failure``, ``code``, ``reason``);
+- discovery: APIGroupList at ``/apis``, APIResourceList at the gv root;
+- the golden file is byte-stable: any codec change that alters the wire
+  shows up as a golden diff, and the CRD's openAPIV3Schema property
+  names must match the serialized spec keys.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tfk8s_tpu import API_VERSION
+from tfk8s_tpu.api import serde
+from tfk8s_tpu.api.types import (
+    CleanPodPolicy, Condition, ContainerSpec, JobConditionType, MeshSpec,
+    ObjectMeta, OwnerReference, ReplicaSpec, ReplicaStatus, ReplicaType,
+    RestartPolicy, RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec,
+    TPUJobStatus, TPUSpec,
+)
+from tfk8s_tpu.client.apiserver import APIServer
+from tfk8s_tpu.client.store import ClusterStore
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)), "golden")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def full_job() -> TPUJob:
+    """A TPUJob exercising every spec/status field, with fixed times so
+    the wire form is byte-stable."""
+    return TPUJob(
+        metadata=ObjectMeta(
+            name="bert-mlm",
+            namespace="ml",
+            uid="uid-123",
+            resource_version=42,
+            generation=3,
+            labels={"tfk8s.dev/job-name": "bert-mlm"},
+            annotations={"tfk8s.dev/checkpoint-dir": "/ckpt"},
+            finalizers=["tfk8s.dev/cleanup"],
+            owner_references=[
+                OwnerReference(kind="TPUJob", name="parent", uid="uid-0")
+            ],
+            creation_timestamp=1700000000.25,
+            deletion_timestamp=None,
+        ),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=4,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    max_restarts=2,
+                    template=ContainerSpec(
+                        entrypoint="tfk8s_tpu.models.bert:train",
+                        image="gcr.io/x/bert:1",
+                        command=["python"],
+                        args=["-m", "train"],
+                        env={"TFK8S_TRAIN_STEPS": "100"},
+                        resources={"google.com/tpu": 4},
+                    ),
+                ),
+                ReplicaType.EVALUATOR: ReplicaSpec(
+                    replicas=1,
+                    template=ContainerSpec(entrypoint="tfk8s_tpu.models.bert:evaluate"),
+                ),
+            },
+            tpu=TPUSpec(
+                accelerator="v5p-32", topology="2x2x4", num_slices=1,
+                provider="gke",
+            ),
+            mesh=MeshSpec(axes={"data": 8, "fsdp": 2}),
+            run_policy=RunPolicy(
+                clean_pod_policy=CleanPodPolicy.RUNNING,
+                ttl_seconds_after_finished=300.0,
+                active_deadline_seconds=3600.0,
+                backoff_limit=3,
+                suspend=False,
+                scheduling=SchedulingPolicy(
+                    gang=True, priority=10, admission_timeout_s=60.0
+                ),
+            ),
+        ),
+        status=TPUJobStatus(
+            conditions=[
+                Condition(
+                    type=JobConditionType.RUNNING,
+                    status=True,
+                    reason="TPUJobRunning",
+                    message="all replicas running",
+                    last_transition_time=1700000100.5,
+                )
+            ],
+            replica_statuses={
+                ReplicaType.WORKER: ReplicaStatus(active=4, restarts=1)
+            },
+            start_time=1700000050.0,
+            completion_time=None,
+            gang_restarts=1,
+            preemptions=0,
+            checkpoint_step=500,
+        ),
+    )
+
+
+class TestGolden:
+    def test_wire_matches_golden_file(self):
+        got = json.dumps(serde.to_wire(full_job()), indent=2, sort_keys=True)
+        path = os.path.join(GOLDEN, "tpujob_wire.json")
+        want = open(path).read().strip()
+        assert got.strip() == want, (
+            f"wire form drifted from {path} — if the change is "
+            "intentional, regenerate the golden file"
+        )
+
+    def test_golden_decodes_to_equal_object(self):
+        data = json.loads(open(os.path.join(GOLDEN, "tpujob_wire.json")).read())
+        back = serde.decode_object(data)
+        want = full_job()
+        # timestamps round-trip at microsecond precision (RFC3339 %f)
+        assert back == want
+
+    def test_casing_conventions(self):
+        w = serde.to_wire(full_job())
+        assert w["apiVersion"] == API_VERSION and w["kind"] == "TPUJob"
+        assert w["metadata"]["resourceVersion"] == "42"  # opaque string
+        assert "creationTimestamp" in w["metadata"]
+        assert w["metadata"]["creationTimestamp"].endswith("Z")
+        spec = w["spec"]
+        assert set(spec) == {"replicaSpecs", "tpu", "mesh", "runPolicy"}
+        assert "Worker" in spec["replicaSpecs"]  # map key: data, not cased
+        assert spec["replicaSpecs"]["Worker"]["restartPolicy"] == "OnFailure"
+        assert spec["tpu"]["numSlices"] == 1
+        rp = spec["runPolicy"]
+        assert rp["ttlSecondsAfterFinished"] == 300.0
+        assert rp["backoffLimit"] == 3
+        assert rp["cleanPodPolicy"] == "Running"
+        assert rp["scheduling"]["admissionTimeoutS"] == 60.0
+        st = w["status"]
+        assert st["replicaStatuses"]["Worker"]["active"] == 4
+        assert st["conditions"][0]["lastTransitionTime"].endswith("Z")
+        assert st["startTime"].endswith("Z")
+        # labels/annotations/env keys pass through verbatim
+        assert "tfk8s.dev/job-name" in w["metadata"]["labels"]
+        assert "TFK8S_TRAIN_STEPS" in spec["replicaSpecs"]["Worker"]["template"]["env"]
+
+    def test_snake_case_manifest_still_decodes(self):
+        """Back-compat: the legacy snake_case dump decodes to the same
+        object (old stored bodies / round-1 manifests)."""
+        want = full_job()
+        assert serde.decode_object(serde.to_dict(want)) == want
+
+    def test_crd_schema_matches_wire_spec_keys(self):
+        import yaml
+
+        crd = yaml.safe_load(open(os.path.join(REPO, "manifests", "tpujob-crd.yaml")))
+        schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        spec_props = schema["properties"]["spec"]["properties"]
+        wire_spec = serde.to_wire(full_job())["spec"]
+        assert set(spec_props) == set(wire_spec), (
+            "CRD openAPIV3Schema spec properties must match the wire keys"
+        )
+        tpu_props = spec_props["tpu"]["properties"]
+        assert set(tpu_props) <= set(wire_spec["tpu"])
+        rp_props = spec_props["runPolicy"]["properties"]
+        assert set(rp_props) <= set(wire_spec["runPolicy"]) | {"suspend"}
+
+
+@pytest.fixture()
+def api():
+    server = APIServer(ClusterStore(), port=0)
+    server.serve_background()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def _http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+        return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestHTTPConformance:
+    """Round-trip a TPUJob through the HTTP apiserver speaking ONLY the
+    k8s wire form — what a client-go-shaped tool would put on the wire."""
+
+    def test_create_get_list_delete_k8s_casing(self, api):
+        base = f"{api.url}/apis/{API_VERSION}/namespaces/ml/tpujobs"
+        body = serde.to_wire(full_job())
+        del body["metadata"]["resourceVersion"]  # server assigns
+
+        code, created = _http("POST", base, body)
+        assert code == 201
+        assert created["apiVersion"] == API_VERSION
+        assert created["kind"] == "TPUJob"
+        assert isinstance(created["metadata"]["resourceVersion"], str)
+        assert created["spec"]["replicaSpecs"]["Worker"]["replicas"] == 4
+        assert created["spec"]["runPolicy"]["backoffLimit"] == 3
+
+        code, got = _http("GET", f"{base}/bert-mlm")
+        assert code == 200
+        assert got["spec"]["tpu"]["numSlices"] == 1
+
+        code, lst = _http("GET", base)
+        assert code == 200
+        assert lst["kind"] == "TPUJobList"
+        assert lst["apiVersion"] == API_VERSION
+        assert isinstance(lst["metadata"]["resourceVersion"], str)
+        assert len(lst["items"]) == 1
+        assert lst["items"][0]["metadata"]["name"] == "bert-mlm"
+
+        code, err = _http("GET", f"{base}/nope")
+        assert code == 404
+        assert err["kind"] == "Status"
+        assert err["status"] == "Failure"
+        assert err["reason"] == "NotFound"
+        assert err["code"] == 404
+
+    def test_watch_events_k8s_shape(self, api):
+        base = f"{api.url}/apis/{API_VERSION}/namespaces/ml/tpujobs"
+        code, _ = _http("POST", base, serde.to_wire(full_job()))
+        assert code == 201
+        url = f"{api.url}/apis/{API_VERSION}/tpujobs?watch=1&resourceVersion=0"
+        resp = urllib.request.urlopen(url, timeout=10)
+        try:
+            for raw in resp:
+                ev = json.loads(raw)
+                if ev.get("type") == "HEARTBEAT":
+                    continue
+                assert ev["type"] == "ADDED"
+                obj = ev["object"]
+                assert obj["kind"] == "TPUJob"
+                assert obj["apiVersion"] == API_VERSION
+                assert obj["spec"]["replicaSpecs"]["Worker"]["replicas"] == 4
+                break
+        finally:
+            resp.close()
+
+    def test_discovery_docs(self, api):
+        code, groups = _http("GET", f"{api.url}/apis")
+        assert code == 200
+        assert groups["kind"] == "APIGroupList"
+        names = [g["name"] for g in groups["groups"]]
+        assert API_VERSION.split("/")[0] in names
+
+        code, res = _http("GET", f"{api.url}/apis/{API_VERSION}")
+        assert code == 200
+        assert res["kind"] == "APIResourceList"
+        assert res["groupVersion"] == API_VERSION
+        by_name = {r["name"]: r for r in res["resources"]}
+        assert by_name["tpujobs"]["kind"] == "TPUJob"
+        assert "watch" in by_name["tpujobs"]["verbs"]
+        assert "tpujobs/status" in by_name

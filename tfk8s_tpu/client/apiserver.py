@@ -77,8 +77,16 @@ class _AdmissionRejected(Exception):
 
 
 def _err_body(status: int, reason: str, message: str) -> bytes:
+    # the k8s metav1.Status failure envelope
     return json.dumps(
-        {"kind": "Status", "code": status, "reason": reason, "message": message}
+        {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "code": status,
+            "reason": reason,
+            "message": message,
+        }
     ).encode()
 
 
@@ -159,6 +167,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/apis" or self.path == "/apis/":
             self._send_json(200, self.server.discovery_doc())
             return
+        if self.path.rstrip("/") == f"/apis/{API_VERSION}":
+            self._send_json(200, self.server.resource_list())
+            return
         if self.path == "/healthz":
             self._send_json(200, {"status": "ok"})
             return
@@ -170,19 +181,22 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if name is not None:
                 obj = self.server.store.get(kind, ns or "default", name)
-                self._send_json(200, serde.to_dict(obj))
+                self._send_json(200, serde.to_wire(obj))
                 return
             if query.get("watch") in ("1", "true"):
                 self._serve_watch(kind, query)
                 return
             selector = _parse_selector(query.get("labelSelector", ""))
             items, rv = self.server.store.list(kind, ns, selector or None)
+            # the k8s *List envelope: ListMeta.resourceVersion is the
+            # store's version at list time (the reflector's watch cursor)
             self._send_json(
                 200,
                 {
+                    "apiVersion": serde.api_version_of(kind),
                     "kind": f"{kind}List",
-                    "items": [serde.to_dict(o) for o in items],
-                    "resourceVersion": rv,
+                    "metadata": {"resourceVersion": str(rv)},
+                    "items": [serde.to_wire(o) for o in items],
                 },
             )
         except Exception as e:  # noqa: BLE001 — mapped to protocol errors
@@ -215,7 +229,7 @@ class _Handler(BaseHTTPRequestHandler):
                 obj.metadata.namespace = ns
             self._admit(obj)
             created = self.server.store.create(obj)
-            self._send_json(201, serde.to_dict(created))
+            self._send_json(201, serde.to_wire(created))
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
@@ -251,7 +265,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._admit(obj)
                 updated = self.server.store.update(obj)
-            self._send_json(200, serde.to_dict(updated))
+            self._send_json(200, serde.to_wire(updated))
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
@@ -263,7 +277,7 @@ class _Handler(BaseHTTPRequestHandler):
         kind, ns, name, _st, _q = route
         try:
             deleted = self.server.store.delete(kind, ns or "default", name)
-            self._send_json(200, serde.to_dict(deleted))
+            self._send_json(200, serde.to_wire(deleted))
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
@@ -287,7 +301,7 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     line = (
                         json.dumps(
-                            {"type": ev.type.value, "object": serde.to_dict(ev.object)}
+                            {"type": ev.type.value, "object": serde.to_wire(ev.object)}
                         ).encode()
                         + b"\n"
                     )
@@ -339,10 +353,42 @@ class APIServer(ThreadingHTTPServer):
         return f"http://{self.server_address[0]}:{self.port}"
 
     def discovery_doc(self) -> Dict[str, Any]:
+        # metav1.APIGroupList, what `kubectl api-versions` reads at /apis
+        group, version = API_VERSION.split("/")
+        gv = {"groupVersion": API_VERSION, "version": version}
         return {
-            "api_path": "/apis",
-            "group_version": API_VERSION,
-            "resources": sorted(PLURALS),
+            "kind": "APIGroupList",
+            "apiVersion": "v1",
+            "groups": [
+                {"name": group, "versions": [gv], "preferredVersion": gv}
+            ],
+        }
+
+    def resource_list(self) -> Dict[str, Any]:
+        # metav1.APIResourceList for the group-version (kubectl api-resources)
+        verbs = ["create", "delete", "get", "list", "update", "watch"]
+        return {
+            "kind": "APIResourceList",
+            "apiVersion": "v1",
+            "groupVersion": API_VERSION,
+            "resources": [
+                {
+                    "name": plural,
+                    "kind": kind,
+                    "namespaced": True,
+                    "verbs": verbs,
+                }
+                for plural, kind in sorted(PLURALS.items())
+            ]
+            + [
+                {
+                    "name": f"{plural}/status",
+                    "kind": kind,
+                    "namespaced": True,
+                    "verbs": ["update"],
+                }
+                for plural, kind in sorted(PLURALS.items())
+            ],
         }
 
     def serve_background(self) -> int:

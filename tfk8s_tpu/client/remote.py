@@ -173,7 +173,7 @@ class RemoteStore:
         data = self._request(
             "POST",
             self._path(obj.kind, obj.metadata.namespace or "default"),
-            body=serde.to_dict(obj),
+            body=serde.to_wire(obj),
         )
         return serde.decode_object(data)
 
@@ -194,13 +194,18 @@ class RemoteStore:
             )
         data = self._request("GET", self._path(kind, namespace), query=query or None)
         items = [serde.decode_object(d) for d in data.get("items", [])]
-        return items, int(data.get("resourceVersion", 0))
+        # k8s ListMeta.resourceVersion (string); legacy top-level int kept
+        # for mixed-version rollouts
+        rv = data.get("metadata", {}).get(
+            "resourceVersion", data.get("resourceVersion", 0)
+        )
+        return items, int(rv)
 
     def update(self, obj: Any) -> Any:
         data = self._request(
             "PUT",
             self._path(obj.kind, obj.metadata.namespace or "default", obj.metadata.name),
-            body=serde.to_dict(obj),
+            body=serde.to_wire(obj),
         )
         return serde.decode_object(data)
 
@@ -209,7 +214,7 @@ class RemoteStore:
             "PUT",
             self._path(obj.kind, obj.metadata.namespace or "default", obj.metadata.name)
             + "/status",
-            body=serde.to_dict(obj),
+            body=serde.to_wire(obj),
         )
         return serde.decode_object(data)
 

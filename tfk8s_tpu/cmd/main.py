@@ -360,7 +360,7 @@ def _cmd_get(args: argparse.Namespace) -> int:
     else:
         objs, rv = client.list()
     if args.output == "json":
-        print(json.dumps([serde.to_dict(o) for o in objs], indent=2))
+        print(json.dumps([serde.to_wire(o) for o in objs], indent=2))
         if getattr(args, "watch", False):
             return _stream_watch(client, args, rv)
         return 0
@@ -436,7 +436,7 @@ def _stream_watch(client, args: argparse.Namespace, since_rv: int) -> int:
                 print(
                     json.dumps(
                         {"type": ev.type.value,
-                         "object": serde.to_dict(ev.object)}
+                         "object": serde.to_wire(ev.object)}
                     ),
                     flush=True,
                 )
@@ -459,7 +459,7 @@ def _cmd_describe(args: argparse.Namespace) -> int:
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
     job = cs.tpujobs(args.namespace).get(args.name)
-    print(json.dumps(serde.to_dict(job), indent=2))
+    print(json.dumps(serde.to_wire(job), indent=2))
     # kubectl-describe parity: the object's event history, read from the
     # cluster's mirrored Event objects (operator EventRecorder sink)
     key = f"{args.namespace}/{args.name}"
@@ -561,7 +561,15 @@ def _cmd_apply(args: argparse.Namespace) -> int:
     except AlreadyExists:
         pass
     for _ in range(5):
-        current = client.get(job.metadata.name)
+        try:
+            current = client.get(job.metadata.name)
+        except NotFound:  # deleted since the AlreadyExists; recreate
+            try:
+                client.create(job)
+            except AlreadyExists:
+                continue
+            print(f"tpujob {args.namespace}/{job.metadata.name} created")
+            return 0
         current.spec = job.spec
         try:
             client.update(current)
@@ -570,7 +578,10 @@ def _cmd_apply(args: argparse.Namespace) -> int:
         except Conflict:
             continue
         except NotFound:  # deleted between get and update; recreate
-            client.create(job)
+            try:
+                client.create(job)
+            except AlreadyExists:
+                continue  # re-created concurrently; retry the update path
             print(f"tpujob {args.namespace}/{job.metadata.name} created")
             return 0
     log.error("apply: persistent write conflict; try again")

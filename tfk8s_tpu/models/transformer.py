@@ -34,6 +34,15 @@ from flax import linen as nn
 Dtype = Any
 
 
+def act_constraint(x, *logical):
+    """Trace-time deferral of parallel.sharding.act_constraint — a
+    module-level import would cycle (parallel/__init__ pulls in moe,
+    which imports TransformerConfig from here)."""
+    from tfk8s_tpu.parallel.sharding import act_constraint as _ac
+
+    return _ac(x, *logical)
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -193,8 +202,15 @@ class EncoderLayer(nn.Module):
                 name="moe",
             )(h)
             self.sow("losses", "moe_aux", aux)
-            return x + y
-        return x + MlpBlock(cfg, name="mlp")(h)
+            out = x + y
+        else:
+            out = x + MlpBlock(cfg, name="mlp")(h)
+        # partition_params=False marks a manual-collective region
+        # (shard_map pipeline stage) where mesh-axis constraints are
+        # illegal — skip the activation pin there.
+        if cfg.partition_params:
+            out = act_constraint(out, "batch", "seq", "embed")
+        return out
 
 
 class DecoderLayer(nn.Module):
@@ -220,7 +236,10 @@ class DecoderLayer(nn.Module):
             h, kv=enc, mask=enc_mask
         )
         h = _ln("ln_mlp")(x).astype(cfg.dtype)
-        return x + MlpBlock(cfg, name="mlp")(h)
+        out = x + MlpBlock(cfg, name="mlp")(h)
+        if cfg.partition_params:
+            out = act_constraint(out, "batch", "seq", "embed")
+        return out
 
 
 class Embedder(nn.Module):
@@ -248,14 +267,30 @@ class Embedder(nn.Module):
         )
 
     def __call__(self, ids: jax.Array) -> jax.Array:
-        x = self.tok(ids) + self.pos[: ids.shape[-1]]
+        # Gather-before-use (FSDP convention): reshard the table/pos
+        # params to embed-replicated BEFORE the lookup — a cheap rank-2
+        # param all-gather over ``fsdp`` — so the [b,l,e] activation is
+        # born batch-sharded. Without this the gather inherits the
+        # table's fsdp'd embed dim and GSPMD later needs an
+        # activation-layout flip it can only do by involuntary full
+        # rematerialization (observed on dp×fsdp×tp meshes).
+        if self.cfg.partition_params:
+            table = act_constraint(self.tok.embedding, "vocab", None)
+            pos = act_constraint(self.pos, None, None)
+            x = jnp.take(table, ids, axis=0) + pos[: ids.shape[-1]]
+            x = act_constraint(x, "batch", "seq", "embed")
+        else:
+            x = self.tok(ids) + self.pos[: ids.shape[-1]]
         return x.astype(self.cfg.dtype)
 
     def logits(self, x: jax.Array) -> jax.Array:
         # tied output head; fp32 logits for a stable softmax
-        return jnp.einsum(
+        out = jnp.einsum(
             "...d,vd->...v", x.astype(jnp.float32), self.tok.embedding
         )
+        if self.cfg.partition_params:
+            out = act_constraint(out, "batch", "seq", "vocab")
+        return out
 
 
 def apply_with_aux(model, cfg: TransformerConfig, params, *inputs):

@@ -17,6 +17,8 @@ over ``expert``.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -101,6 +103,41 @@ def shard_constraint(
 ) -> jax.Array:
     """``with_sharding_constraint`` by logical names — activations keep
     their layout through the jitted step without manual PartitionSpecs."""
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, *logical, rules=rules)
+    )
+
+
+# Ambient (mesh, rules) for activation constraints. Model code calls
+# ``act_constraint`` at layer boundaries; outside a Trainer-established
+# context it is a no-op, so the same module code serves eval jits, manual
+# shard_map regions, and tests that never build a mesh.
+_ACT_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "tfk8s_act_sharding", default=None
+)
+
+
+@contextlib.contextmanager
+def activation_sharding(mesh: Mesh, rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES):
+    """Enable ``act_constraint`` within this (trace-time) scope."""
+    token = _ACT_CTX.set((mesh, tuple(rules)))
+    try:
+        yield
+    finally:
+        _ACT_CTX.reset(token)
+
+
+def act_constraint(x: jax.Array, *logical: Optional[str]) -> jax.Array:
+    """Constrain an activation by logical axis names under the ambient
+    ``activation_sharding`` context (no-op without one). Pinning the
+    canonical layout at layer boundaries — batch over data(+fsdp), embed
+    replicated — stops GSPMD from propagating parameter shardings (e.g. the
+    embedding table's fsdp'd embed dim) into activations, which otherwise
+    forces involuntary full rematerializations at layout conflicts."""
+    ctx = _ACT_CTX.get()
+    if ctx is None:
+        return x
+    mesh, rules = ctx
     return jax.lax.with_sharding_constraint(
         x, named_sharding(mesh, *logical, rules=rules)
     )

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import traceback
 from typing import Deque, Dict, List, Optional, Tuple
@@ -43,8 +44,13 @@ LOG_FLUSH_SECONDS = 1.0
 # agent strands its pods Running forever and the gang never recovers
 # (SURVEY.md §3.5 failure path; slice loss must become job restart).
 NODE_LEASE_PREFIX = "node-"
-NODE_LEASE_DURATION_S = 5.0
-NODE_LEASE_RENEW_S = 1.0
+# Deployment-tunable (TFK8S_NODE_LEASE_*): the heartbeat thread shares
+# the pod entrypoints' process (and GIL), so long JAX traces can stall
+# renewal — the default staleness window (2x duration = 40s, the k8s
+# node-lease timeout) must comfortably exceed any single trace. The
+# node-failure test shrinks both to keep the suite fast.
+NODE_LEASE_DURATION_S = float(os.environ.get("TFK8S_NODE_LEASE_DURATION_S", "20.0"))
+NODE_LEASE_RENEW_S = float(os.environ.get("TFK8S_NODE_LEASE_RENEW_S", "4.0"))
 
 
 class _PodLogRouter(logging.Handler):

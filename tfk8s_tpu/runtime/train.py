@@ -22,6 +22,8 @@ checkpoint restore (gang restart) -> fit -> final metrics.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -90,6 +92,13 @@ class TrainConfig:
     profile_dir: str = ""
     profile_skip: int = 3  # steps to skip (compile/warmup) before tracing
     profile_steps: int = 5  # traced step count
+    # Input pipeline depth: >1 runs host batch synthesis + device_put on a
+    # background thread, ``prefetch`` batches ahead of the consuming step
+    # (double-buffering at 2) — host input work overlaps device compute
+    # instead of serializing before every step. <=1 is the synchronous
+    # path. The batch order (and thus the rng stream) is identical either
+    # way; only the overlap changes.
+    prefetch: int = 2
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer is not None:
@@ -115,6 +124,68 @@ def _suffix_match_shardings(abstract_tree, params_paths, mesh):
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(one, abstract_tree)
+
+
+class _BatchPrefetcher:
+    """Bounded producer thread for prepared, device-resident batches.
+
+    The producer synthesizes host batches (in step order, so the rng
+    stream matches the synchronous path exactly) and ``device_put``s them
+    with the batch sharding; the queue depth bounds device-memory held by
+    staged batches. Producer exceptions re-raise in the consumer."""
+
+    _DONE = object()
+
+    def __init__(self, make_batch: Callable[[int], Any], start: int, stop_step: int, depth: int):
+        self._make = make_batch
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._range = (start, stop_step)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="batch-prefetch"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for step in range(*self._range):
+                if self._stop.is_set():
+                    return
+                item = self._make(step)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._exc = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def get(self) -> Any:
+        item = self._q.get()
+        if item is self._DONE:
+            if self._exc is not None:
+                raise self._exc
+            raise RuntimeError("batch prefetcher exhausted early")
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
 
 
 class Trainer:
@@ -152,12 +223,13 @@ class Trainer:
         )
 
         def _init(r) -> TrainState:
-            params = shd.unbox(task.init(r))
-            return TrainState(
-                step=jnp.zeros((), jnp.int32),
-                params=params,
-                opt_state=self.optimizer.init(params),
-            )
+            with shd.activation_sharding(mesh, task.rules):
+                params = shd.unbox(task.init(r))
+                return TrainState(
+                    step=jnp.zeros((), jnp.int32),
+                    params=params,
+                    opt_state=self.optimizer.init(params),
+                )
 
         self._init_fn = jax.jit(_init, out_shardings=self.state_shardings)
 
@@ -174,6 +246,14 @@ class Trainer:
             )(params)
 
         def _step(state: TrainState, batch, r):
+            # Establish the activation-constraint scope for the trace:
+            # model code pins [b,l,e] activations to the canonical layout
+            # (batch over data+fsdp) via shd.act_constraint, which is a
+            # no-op outside this context (see parallel/sharding.py).
+            with shd.activation_sharding(mesh, task.rules):
+                return _step_inner(state, batch, r)
+
+        def _step_inner(state: TrainState, batch, r):
             if accum == 1:
                 (loss, aux), grads = _grads_of(state.params, batch, r)
             else:
@@ -317,35 +397,55 @@ class Trainer:
         prof_stop = prof_start + cfg.profile_steps
         profiling = False
 
-        t0 = time.perf_counter()
-        for step in range(start_step, cfg.steps):
-            if stop is not None and getattr(stop, "is_set", lambda: False)():
-                log.info("%s: stop requested at step %d", self.task.name, step)
-                break
-            if step == prof_start:
-                jax.profiler.start_trace(cfg.profile_dir)
-                profiling = True
+        def _make_device_batch(_step: int):
             host_batch = self.prepare_batch(
                 self.task.make_batch(np_rng, self.task.batch_size)
             )
-            batch = jax.device_put(host_batch, batch_shardings)
-            state, metrics = self._step_fn(state, batch, jax.random.fold_in(jax.random.key(cfg.seed), step))
-            if profiling and step + 1 >= prof_stop:
-                jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
-                profiling = False
-                log.info("%s: profile trace written to %s", self.task.name, cfg.profile_dir)
-            if ckpt and cfg.checkpoint_every and (step + 1) % cfg.checkpoint_every == 0:
-                ckpt.save(step + 1, state)
-            if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
-                m = {k: float(v) for k, v in metrics.items()}
-                m["step"] = step + 1
-                m["steps_per_s"] = (step + 1 - start_step) / (time.perf_counter() - t0)
-                history.append(m)
-                log.info(
-                    "%s step %d: %s", self.task.name, step + 1,
-                    {k: round(v, 4) for k, v in m.items()},
+            return jax.device_put(host_batch, batch_shardings)
+
+        prefetcher = (
+            _BatchPrefetcher(
+                _make_device_batch, start_step, cfg.steps, cfg.prefetch
+            )
+            if cfg.prefetch > 1
+            else None
+        )
+
+        t0 = time.perf_counter()
+        try:
+            for step in range(start_step, cfg.steps):
+                if stop is not None and getattr(stop, "is_set", lambda: False)():
+                    log.info("%s: stop requested at step %d", self.task.name, step)
+                    break
+                if step == prof_start:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                batch = (
+                    prefetcher.get() if prefetcher is not None
+                    else _make_device_batch(step)
                 )
+                state, metrics = self._step_fn(state, batch, jax.random.fold_in(jax.random.key(cfg.seed), step))
+                if profiling and step + 1 >= prof_stop:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log.info("%s: profile trace written to %s", self.task.name, cfg.profile_dir)
+                if ckpt and cfg.checkpoint_every and (step + 1) % cfg.checkpoint_every == 0:
+                    ckpt.save(step + 1, state)
+                if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
+                    m = {k: float(v) for k, v in metrics.items()}
+                    m["step"] = step + 1
+                    m["steps_per_s"] = (step + 1 - start_step) / (time.perf_counter() - t0)
+                    history.append(m)
+                    log.info(
+                        "%s step %d: %s", self.task.name, step + 1,
+                        {k: round(v, 4) for k, v in m.items()},
+                    )
+        finally:
+            # a step-loop exception must not leak the producer thread (it
+            # would spin on its bounded queue holding staged device batches)
+            if prefetcher is not None:
+                prefetcher.close()
         if profiling:  # run ended inside the trace window
             jax.profiler.stop_trace()
         if ckpt and ckpt.enabled:

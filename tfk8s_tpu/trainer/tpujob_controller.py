@@ -171,6 +171,7 @@ class TPUJobController:
             and old.status.exit_code == new.status.exit_code
             and old.status.message == new.status.message
             and old.status.restarts == new.status.restarts
+            and old.status.host == new.status.host
             and old.spec == new.spec
             and old.status.log_tail != new.status.log_tail
         ):

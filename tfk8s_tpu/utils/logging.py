@@ -103,8 +103,10 @@ class EventRecorder:
 
         ns, _, obj_name = ev.key.partition("/")
         ns = ns or "default"
-        # deterministic per (object, reason): repeats aggregate
-        name = f"{obj_name}.{ev.reason.lower()}"
+        # deterministic per (kind, object, reason): repeats aggregate. The
+        # kind is part of the identity — a Pod and a TPUJob sharing a name
+        # in one namespace must not merge into one Event.
+        name = f"{ev.kind.lower()}.{obj_name}.{ev.reason.lower()}"
         client = self._sink.generic("Event", ns)
         for _ in range(3):
             try:
