@@ -91,16 +91,58 @@ def _time_task(task, mesh, steps: int, n_stage: int = 4) -> float:
     return _median_window(timed_once) / steps
 
 
-def _flash_speedup(seq: int = 2048, iters: int = 8):
+def _fit_step_time(task, mesh, steps: int) -> float:
+    """Seconds per step through the PRODUCT loop — ``Trainer.fit`` with
+    its background prefetch pipeline, per-step ``device_put`` and all —
+    so the published scanned number and what ``fit`` delivers can be
+    compared (VERDICT r2 next #3). Compile happens on a primed step
+    before the clock starts."""
+    import jax
+    import numpy as np
+
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    trainer = Trainer(
+        task,
+        TrainConfig(steps=steps + 1, learning_rate=1e-3, log_every=steps + 1,
+                    prefetch=2),
+        mesh,
+    )
+    state = trainer.init_state()
+    batch = jax.device_put(
+        trainer.prepare_batch(
+            task.make_batch(np.random.default_rng(0), task.batch_size)
+        ),
+        trainer.batch_shardings,
+    )
+    state, metrics = trainer._step_fn(state, batch, jax.random.key(0))
+    float(metrics["loss"])  # compile + warm with an honest host barrier
+
+    t0 = time.perf_counter()
+    state, history = trainer.fit(state=state)
+    # fit's final log line already fetched metrics to the host
+    dt = time.perf_counter() - t0
+    done = int(state.step) - 1
+    return dt / max(done, 1)
+
+
+def _flash_speedup(seq: int = 2048, iters: int = 8, blocks=None):
     """Train-shaped attention (fwd+bwd, causal, bf16) at BERT-base head
     geometry: Pallas flash kernels vs the XLA einsum path. Returns
     (flash_ms, xla_ms) per fwd+bwd."""
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from tfk8s_tpu.models.transformer import dot_product_attention
     from tfk8s_tpu.ops.flash_attention import flash_attention
+
+    if blocks is not None:
+        flash_attention = functools.partial(
+            flash_attention, block_q=blocks[0], block_k=blocks[1]
+        )
 
     b, h, d = 8, 12, 64
     rng = np.random.default_rng(0)
@@ -239,12 +281,39 @@ def main() -> None:
         bsteps = 50
     bert_sec = _time_task(bert_task, mesh, bsteps)
 
-    # -- flash-attention win at long sequence (VERDICT r2 item #6) ----------
+    # -- the PRODUCT loop: Trainer.fit with its prefetch pipeline must
+    # agree with the scanned number (VERDICT r2 next #3). Measured on
+    # BERT: its per-step host batch is ~64 KB, so the remote tunnel's
+    # ~10 MB/s host->device link (which makes a per-step 154 MB ResNet
+    # batch physically untimeable here — seconds per transfer; see
+    # PERF_RESNET.md) stays off the critical path. The CPU-mesh test
+    # tests/test_train_runtime.py covers the ResNet-shaped agreement.
+    fit_sec = _fit_step_time(bert_task, mesh, 12 if small else 30)
+
+    # -- flash-attention win at long sequence (VERDICT r2 #4): autotuned
+    # blocks, plus a REAL long-context model row (BERT seq-2048, flash)
     flash_ms = xla_ms = None
+    flash_blocks = None
+    bert2k_sec = None
     if not small and os.environ.get("BENCH_FLASH", "1") == "1":
-        flash_ms, xla_ms = _flash_speedup(
-            seq=int(os.environ.get("BENCH_FLASH_SEQ", "2048"))
-        )
+        from tfk8s_tpu.ops.flash_attention import autotune_blocks, pick_blocks
+
+        fseq = int(os.environ.get("BENCH_FLASH_SEQ", "2048"))
+        tuned = autotune_blocks(fseq)
+        # no tuned winner -> the static divisibility-safe choice; if even
+        # that is None (seq not a 128 multiple) SKIP the flash rows
+        # instead of aborting the whole bench on the kernel's
+        # divisibility assert
+        flash_blocks = tuned[:2] if tuned else pick_blocks(fseq)
+        if flash_blocks is not None:
+            flash_ms, xla_ms = _flash_speedup(seq=fseq, blocks=flash_blocks)
+
+            bert2k_cfg = bert.base_config(max_len=2048)
+            bert2k_task = bert.task_for_mesh(
+                mesh, cfg=bert2k_cfg, seq_len=2048,
+                batch_size=int(os.environ.get("BENCH_BERT2K_BATCH", "8")),
+            )
+            bert2k_sec = _time_task(bert2k_task, mesh, 20)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
@@ -265,6 +334,25 @@ def main() -> None:
         except (ValueError, KeyError):
             pass
 
+    # Absolute efficiency (VERDICT r2 next #1): MFU from model FLOPs and
+    # the chip's bf16 spec — drift-proof, unlike the ±5% vs_baseline
+    # ratio on this shared chip. ResNet-50@224 fwd ≈ 4.11 GFLOP/image,
+    # train ≈ 3x fwd; BERT train ≈ 6 * params * tokens (110M params).
+    # The constants describe the FULL configs on the v5e, so the fields
+    # are omitted in BENCH_SMALL mode (tiny models, other backend).
+    PEAK_BF16 = 197e12  # v5e
+    mfu_fields = {}
+    if not small:
+        resnet_mfu = (
+            rn_task.batch_size * 3 * 4.11e9 / sec_per_step
+        ) / PEAK_BF16
+        bert_tokens = bert_task.batch_size * bert_seq
+        bert_mfu = (6 * 110e6 * bert_tokens / bert_sec) / PEAK_BF16
+        mfu_fields = {
+            "resnet_mfu": round(resnet_mfu, 4),
+            "bert_mfu": round(bert_mfu, 4),
+        }
+
     print(
         json.dumps(
             {
@@ -274,7 +362,10 @@ def main() -> None:
                 "vs_baseline": round(vs, 4),
                 "extra": {
                     **baseline_note,
+                    **mfu_fields,
                     "bert_base_mlm_step_time_ms": round(bert_sec * 1000, 3),
+                    "bert_fit_step_time_ms": round(fit_sec * 1000, 3),
+                    "bert_fit_vs_scanned": round(fit_sec / bert_sec, 3),
                     "bert_batch_size": bert_task.batch_size,
                     "bert_seq_len": bert_seq,
                     "resnet_batch_size": rn_task.batch_size,
@@ -284,8 +375,21 @@ def main() -> None:
                             "flash_attn_ms_seq2048": round(flash_ms, 3),
                             "xla_attn_ms_seq2048": round(xla_ms, 3),
                             "flash_attn_speedup": round(xla_ms / flash_ms, 3),
+                            "flash_blocks": list(flash_blocks or ()),
                         }
                         if flash_ms
+                        else {}
+                    ),
+                    **(
+                        {
+                            "bert_seq2048_flash_step_time_ms": round(
+                                bert2k_sec * 1000, 3
+                            ),
+                            "bert_seq2048_batch_size": int(
+                                os.environ.get("BENCH_BERT2K_BATCH", "8")
+                            ),
+                        }
+                        if bert2k_sec
                         else {}
                     ),
                 },

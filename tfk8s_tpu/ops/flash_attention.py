@@ -43,12 +43,93 @@ _NEG = -1e30
 # buffer starts to hurt HBM (and eventually OOMs).
 FLASH_SEQ_THRESHOLD = 1024
 
-# Default q/k block sizes. Auto-selection (models/bert.py task_for_mesh)
-# requires the sequence length to be a DEFAULT_BLOCK_Q multiple so these
-# defaults divide it; explicit attention_impl="flash" configs may pass
-# their own blocks.
+# Default q/k block sizes; explicit attention_impl="flash" configs may
+# pass their own. Auto-selection picks the largest candidates that
+# divide the sequence (pick_blocks), so any 128-multiple length
+# qualifies — not just DEFAULT_BLOCK_Q multiples (VERDICT r2 next #4).
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 256
+_BLOCK_Q_CANDIDATES = (512, 256, 128)
+_BLOCK_K_CANDIDATES = (256, 128)
+
+
+def pick_blocks(seq_len: int):
+    """Largest (block_q, block_k) candidates dividing ``seq_len``, or
+    None when no candidate divides it (seq not a 128 multiple)."""
+    bq = next((b for b in _BLOCK_Q_CANDIDATES if seq_len % b == 0), None)
+    bk = next((b for b in _BLOCK_K_CANDIDATES if seq_len % b == 0), None)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
+
+
+def autotune_blocks(
+    seq_len: int,
+    batch: int = 8,
+    heads: int = 12,
+    head_dim: int = 64,
+    candidates=None,
+    iters: int = 4,
+    causal: bool = True,
+):
+    """Time fwd+bwd for each (block_q, block_k) candidate at the given
+    geometry on the CURRENT backend and return (block_q, block_k, ms).
+    Meant for bench/build time (each candidate costs a compile); runtime
+    callers use pick_blocks' static choice."""
+    import time as _time
+
+    import numpy as np
+
+    if candidates is None:
+        candidates = [(512, 256), (512, 512), (256, 256), (1024, 512)]
+    candidates = [
+        (bq, bk) for bq, bk in candidates
+        if seq_len % bq == 0 and seq_len % bk == 0
+    ]
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((batch, seq_len, heads, head_dim)), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+    best = None
+    for bq, bk in candidates:
+        grad = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(
+                    q, k, v, causal=causal, block_q=bq, block_k=bk
+                ).astype(jnp.float32) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )
+
+        # k/v enter as jit ARGUMENTS (the scan body closes over their
+        # TRACED values, which become loop-invariant captures): closing
+        # over the device arrays themselves would bake tens of MB of
+        # constants into each candidate's HLO — the round-1
+        # remote-compile 413 failure mode (bench.py docstring).
+        def _run(q, k, v):
+            def body(c, _):
+                dq, dk, dv = grad(c, k, v)
+                return c + 0.0 * (dq + dk + dv).astype(c.dtype), ()
+
+            return jax.lax.scan(body, q, None, length=iters)[0]
+
+        run = jax.jit(_run)
+        try:
+            out = run(q, k, v)
+            float(jnp.sum(out.astype(jnp.float32)))  # compile + warm
+        except Exception:  # noqa: BLE001 — e.g. VMEM overflow at this block
+            continue
+        times = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            out = run(q, k, v)
+            float(jnp.sum(out.astype(jnp.float32)))
+            times.append(_time.perf_counter() - t0)
+        ms = sorted(times)[1] / iters * 1000
+        if best is None or ms < best[2]:
+            best = (bq, bk, ms)
+    return best
 
 # Mosaic requires the last two dims of every block to be (8k, 128k) or
 # equal to the array dims, so the per-row logsumexp is stored broadcast
@@ -434,12 +515,11 @@ def auto_flash_attn_fn(attention_impl: str, seq_len: int):
             f"unknown attention_impl {attention_impl!r}; expected one of "
             "'auto', 'full', 'flash', 'ring', 'ulysses'"
         )
-    if (
-        _on_tpu()
-        and seq_len >= FLASH_SEQ_THRESHOLD
-        and seq_len % DEFAULT_BLOCK_Q == 0
-    ):
-        return flash_attention
+    blocks = pick_blocks(seq_len)
+    if _on_tpu() and seq_len >= FLASH_SEQ_THRESHOLD and blocks is not None:
+        return functools.partial(
+            flash_attention, block_q=blocks[0], block_k=blocks[1]
+        )
     return None
 
 

@@ -128,6 +128,10 @@ class LocalKubelet:
         # last tail actually published per pod — skips the per-cycle GET
         # for pods whose buffer hasn't changed
         self._log_published: Dict[Tuple[str, str], List[str]] = {}
+        # (pod key, uid) -> entrypoint thread ident, for reading the
+        # thread's training-progress report (runtime/progress.py)
+        self._progress_idents: Dict[Tuple[str, str], int] = {}
+        self._progress_published: Dict[Tuple[str, str], Dict[str, float]] = {}
         self._log_router = _PodLogRouter()
 
     def run(self, stop: threading.Event) -> None:
@@ -194,6 +198,8 @@ class LocalKubelet:
         status, so `logs` works mid-run (final flush rides the terminal
         _set_phase). Runs OUTSIDE the logging handler — a flush that
         itself logs (update conflicts) must not recurse into capture."""
+        from tfk8s_tpu.runtime import progress as _progress
+
         while self._stop is not None and not self._stop.is_set():
             try:
                 with self._lock:
@@ -201,15 +207,31 @@ class LocalKubelet:
                         k: self._log_router.snapshot(buf)
                         for k, buf in self._log_bufs.items()
                     }
+                    idents = dict(self._progress_idents)
                 for (key, uid), lines in snapshot.items():
-                    if lines and self._log_published.get((key, uid)) != lines:
-                        self._publish_logs(key, uid, lines)
+                    training = (
+                        _progress.snapshot(idents[(key, uid)])
+                        if (key, uid) in idents
+                        else {}
+                    )
+                    stale_logs = (
+                        lines and self._log_published.get((key, uid)) != lines
+                    )
+                    stale_training = (
+                        training
+                        and self._progress_published.get((key, uid)) != training
+                    )
+                    if stale_logs or stale_training:
+                        self._publish_status(key, uid, lines, training)
             except Exception:  # noqa: BLE001 — the flusher must survive
                 log.debug("log flush cycle failed:\n%s", traceback.format_exc())
             self._stop.wait(LOG_FLUSH_SECONDS)
         logging.getLogger("tfk8s").removeHandler(self._log_router)
 
-    def _publish_logs(self, pod_key: str, uid: str, lines: List[str]) -> bool:
+    def _publish_status(
+        self, pod_key: str, uid: str, lines: List[str],
+        training: Optional[Dict[str, float]] = None,
+    ) -> bool:
         # the terminal _set_phase owns the FINAL tail: once the pod's
         # buffer is retired, a stale snapshot must not overwrite it
         with self._lock:
@@ -225,13 +247,22 @@ class LocalKubelet:
                 return False
             if current.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
                 return False  # terminal writer already published
-            if current.status.log_tail == lines:
+            if (
+                current.status.log_tail == lines
+                and (not training or current.status.training == training)
+            ):
                 self._log_published[(pod_key, uid)] = lines
+                if training:
+                    self._progress_published[(pod_key, uid)] = training
                 return True  # nothing new since the last flush
             current.status.log_tail = lines
+            if training:
+                current.status.training = dict(training)
             try:
                 self.cs.pods(ns).update_status(current)
                 self._log_published[(pod_key, uid)] = lines
+                if training:
+                    self._progress_published[(pod_key, uid)] = training
                 return True
             except Conflict:
                 continue
@@ -312,6 +343,7 @@ class LocalKubelet:
         buf = self._log_router.register(ident)
         with self._lock:
             self._log_bufs[(key, uid)] = buf
+            self._progress_idents[(key, uid)] = ident
         try:
             container = pod.spec.containers[0]
             env = dict(container.env)
@@ -349,7 +381,12 @@ class LocalKubelet:
             log.debug("%s", traceback.format_exc())
         finally:
             self._log_router.unregister(ident)
+            from tfk8s_tpu.runtime import progress as _progress
+
+            _progress.clear(ident)
             with self._lock:
                 self._claimed.pop((key, uid), None)
                 self._log_bufs.pop((key, uid), None)
                 self._log_published.pop((key, uid), None)
+                self._progress_idents.pop((key, uid), None)
+                self._progress_published.pop((key, uid), None)

@@ -1,0 +1,47 @@
+"""Training-progress reporting: trainer → kubelet → pod status → operator
+/metrics (VERDICT r2 next #8; SURVEY.md §5 metrics row).
+
+The hermetic node runs each pod's entrypoint on its own kubelet thread,
+so progress routes the same way the log tail does (runtime/kubelet.py
+_PodLogRouter): the trainer calls :func:`report` from the pod thread,
+the kubelet's flush loop snapshots the thread's latest values into
+``pod.status.training``, and the operator mirrors them into per-job
+gauges/histograms on its /metrics endpoint. Outside a kubelet (bench,
+direct run_task) reporting is a cheap dict write nobody reads.
+
+On a real multi-host deployment the same contract rides the identical
+path: the trainer process reports, the node agent publishes to pod
+status, the operator scrapes — no side channel."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_LOCK = threading.Lock()
+_BY_THREAD: Dict[int, Dict[str, float]] = {}
+
+
+def report(**values: float) -> None:
+    """Merge numeric progress values for the CALLING thread (the pod
+    entrypoint thread). Keys are metric suffixes, e.g. ``step``,
+    ``steps_per_sec``, ``examples_per_sec``, ``step_seconds``."""
+    ident = threading.get_ident()
+    clean = {k: float(v) for k, v in values.items()}
+    with _LOCK:
+        _BY_THREAD.setdefault(ident, {}).update(clean)
+
+
+def snapshot(ident: Optional[int] = None) -> Dict[str, float]:
+    """Latest values for ``ident`` (defaults to the calling thread)."""
+    if ident is None:
+        ident = threading.get_ident()
+    with _LOCK:
+        return dict(_BY_THREAD.get(ident, {}))
+
+
+def clear(ident: Optional[int] = None) -> None:
+    if ident is None:
+        ident = threading.get_ident()
+    with _LOCK:
+        _BY_THREAD.pop(ident, None)

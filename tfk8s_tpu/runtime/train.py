@@ -21,6 +21,7 @@ checkpoint restore (gang restart) -> fit -> final metrics.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
@@ -36,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tfk8s_tpu.parallel import sharding as shd
 from tfk8s_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, MeshConfig
+from tfk8s_tpu.runtime import progress
 from tfk8s_tpu.runtime.checkpoint import Checkpointer
 from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
 from tfk8s_tpu.utils.logging import get_logger
@@ -99,6 +101,19 @@ class TrainConfig:
     # path. The batch order (and thus the rng stream) is identical either
     # way; only the overlap changes.
     prefetch: int = 2
+    # Async-dispatch window: how many steps may be in flight before the
+    # loop waits on the oldest step's output. With the input pipeline
+    # removing all host-side throttle, an unbounded loop enqueues every
+    # remaining step at Python speed — the backend's inflight state grows
+    # without bound (observed: CPU-client abort after ~200 unsynced
+    # steps; on real chips it is an HBM liability). The wait is on a
+    # SCALAR from ``max_inflight`` steps ago: zero transfer, no pipeline
+    # bubble as long as the window exceeds the dispatch depth. None =
+    # backend-aware default: the CPU client aborts somewhere between 16
+    # and 48 inflight executions (measured), so 16 there; real TPU
+    # runtimes take deep queues and every wait through the remote tunnel
+    # costs a round trip, so 256 on tpu/axon.
+    max_inflight: Optional[int] = None
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer is not None:
@@ -127,12 +142,17 @@ def _suffix_match_shardings(abstract_tree, params_paths, mesh):
 
 
 class _BatchPrefetcher:
-    """Bounded producer thread for prepared, device-resident batches.
+    """Bounded producer thread for prepared HOST batches.
 
-    The producer synthesizes host batches (in step order, so the rng
-    stream matches the synchronous path exactly) and ``device_put``s them
-    with the batch sharding; the queue depth bounds device-memory held by
-    staged batches. Producer exceptions re-raise in the consumer."""
+    The producer synthesizes and shape-prepares batches (in step order,
+    so the rng stream matches the synchronous path exactly); the
+    CONSUMER does the ``device_put`` on dequeue. Keeping every JAX call
+    on the consumer thread matters: concurrent ``device_put`` against a
+    running jitted step intermittently aborts the CPU client (observed
+    as suite-killing ``Fatal Python error: Aborted``), and on TPU the
+    transfer is an async enqueue anyway — the overlap that pays is the
+    HOST synthesis, which is exactly what the thread offloads. Producer
+    exceptions re-raise in the consumer."""
 
     _DONE = object()
 
@@ -246,6 +266,12 @@ class Trainer:
             )(params)
 
         def _step(state: TrainState, batch, r):
+            # Fold the step index into the rng INSIDE the jit: callers
+            # pass one base key for the whole run, so the fit loop does
+            # zero per-step host-side key computations (each of which is
+            # a separate device dispatch — ruinous through a remote
+            # tunnel, and wasted latency anywhere).
+            r = jax.random.fold_in(r, state.step)
             # Establish the activation-constraint scope for the trace:
             # model code pins [b,l,e] activations to the canonical layout
             # (batch over data+fsdp) via shd.act_constraint, which is a
@@ -396,22 +422,33 @@ class Trainer:
         prof_start = start_step + cfg.profile_skip if cfg.profile_dir else -1
         prof_stop = prof_start + cfg.profile_steps
         profiling = False
+        # one base key for the run; the jitted step folds in state.step
+        base_key = jax.random.key(cfg.seed)
 
-        def _make_device_batch(_step: int):
-            host_batch = self.prepare_batch(
+        def _make_host_batch(_step: int):
+            return self.prepare_batch(
                 self.task.make_batch(np_rng, self.task.batch_size)
             )
-            return jax.device_put(host_batch, batch_shardings)
 
         prefetcher = (
             _BatchPrefetcher(
-                _make_device_batch, start_step, cfg.steps, cfg.prefetch
+                _make_host_batch, start_step, cfg.steps, cfg.prefetch
             )
             if cfg.prefetch > 1
             else None
         )
 
+        inflight: "collections.deque" = collections.deque()
+        if cfg.max_inflight is not None:
+            max_inflight = max(cfg.max_inflight, 1)
+        else:
+            plat = jax.devices()[0].platform
+            max_inflight = 256 if plat in ("tpu", "axon") else 16
         t0 = time.perf_counter()
+        # window anchor for the REPORTED step rate: rates must describe
+        # the last interval (what an operator alert needs), not a
+        # cumulative average that still carries the first-step compile
+        last_report = (start_step, t0)
         try:
             for step in range(start_step, cfg.steps):
                 if stop is not None and getattr(stop, "is_set", lambda: False)():
@@ -420,11 +457,17 @@ class Trainer:
                 if step == prof_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
-                batch = (
+                host_batch = (
                     prefetcher.get() if prefetcher is not None
-                    else _make_device_batch(step)
+                    else _make_host_batch(step)
                 )
-                state, metrics = self._step_fn(state, batch, jax.random.fold_in(jax.random.key(cfg.seed), step))
+                # device_put stays on THIS thread (see _BatchPrefetcher);
+                # it is an async enqueue, not a synchronous copy
+                batch = jax.device_put(host_batch, batch_shardings)
+                state, metrics = self._step_fn(state, batch, base_key)
+                inflight.append(metrics["loss"])
+                if len(inflight) > max_inflight:
+                    jax.block_until_ready(inflight.popleft())
                 if profiling and step + 1 >= prof_stop:
                     jax.block_until_ready(metrics["loss"])
                     jax.profiler.stop_trace()
@@ -435,8 +478,22 @@ class Trainer:
                 if (step + 1) % cfg.log_every == 0 or step + 1 == cfg.steps:
                     m = {k: float(v) for k, v in metrics.items()}
                     m["step"] = step + 1
-                    m["steps_per_s"] = (step + 1 - start_step) / (time.perf_counter() - t0)
+                    now = time.perf_counter()
+                    m["steps_per_s"] = (step + 1 - start_step) / (now - t0)
                     history.append(m)
+                    # surface step-rate/throughput to the node agent →
+                    # pod status → operator /metrics (runtime/progress.py);
+                    # WINDOWED rate: steps/seconds since the last report
+                    w_steps = step + 1 - last_report[0]
+                    w_dt = max(now - last_report[1], 1e-9)
+                    last_report = (step + 1, now)
+                    rate = w_steps / w_dt
+                    progress.report(
+                        step=step + 1,
+                        steps_per_sec=rate,
+                        examples_per_sec=rate * self.task.batch_size,
+                        step_seconds=w_dt / w_steps,
+                    )
                     log.info(
                         "%s step %d: %s", self.task.name, step + 1,
                         {k: round(v, 4) for k, v in m.items()},

@@ -163,6 +163,23 @@ class TPUJobController:
             self.controller.enqueue_key(f"{meta.namespace}/{job_name}")
 
     def _pod_updated(self, old: Pod, new: Pod) -> None:
+        # Mirror reported training progress into per-job /metrics series
+        # (VERDICT r2 next #8): a running job's step rate and throughput
+        # are operator-visible without touching the reconcile path.
+        if new.status.training and new.status.training != old.status.training:
+            job = new.metadata.labels.get(L.JOB_NAME)
+            if job:
+                series = f"tpujob.training.{new.metadata.namespace}.{job}"
+                for k in ("steps_per_sec", "examples_per_sec", "step"):
+                    if k in new.status.training:
+                        self.metrics.set_gauge(
+                            f"{series}.{k}", new.status.training[k]
+                        )
+                if "step_seconds" in new.status.training:
+                    self.metrics.observe(
+                        f"{series}.step_seconds",
+                        new.status.training["step_seconds"],
+                    )
         if (
             old.metadata.resource_version != new.metadata.resource_version
             and old.metadata.uid == new.metadata.uid
@@ -173,9 +190,12 @@ class TPUJobController:
             and old.status.restarts == new.status.restarts
             and old.status.host == new.status.host
             and old.spec == new.spec
-            and old.status.log_tail != new.status.log_tail
+            and (
+                old.status.log_tail != new.status.log_tail
+                or old.status.training != new.status.training
+            )
         ):
-            return  # log-flush-only refresh; nothing to reconcile
+            return  # log-flush/progress-only refresh; nothing to reconcile
         self._enqueue_owner(new)
 
     def run(self, workers: int, stop, block: bool = True) -> bool:
@@ -1029,3 +1049,7 @@ class TPUJobController:
         # a deleted job leaves no Event objects behind
         self.recorder.flush()
         self._delete_job_events(job)
+        # ... and no /metrics series either (same leave-nothing contract)
+        self.metrics.remove_prefix(
+            f"tpujob.training.{job.metadata.namespace}.{job.metadata.name}."
+        )

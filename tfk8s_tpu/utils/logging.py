@@ -170,6 +170,15 @@ class Metrics:
         with self._lock:
             self.gauges[name] = value
 
+    def remove_prefix(self, prefix: str) -> None:
+        """Drop every series whose name starts with ``prefix`` — per-job
+        series (tpujob.training.<ns>.<job>.*) must die with their job or
+        a long-lived operator leaks memory and scrapes stale values."""
+        with self._lock:
+            for table in (self.counters, self.gauges, self.hist_counts, self.hist_sum):
+                for name in [n for n in table if n.startswith(prefix)]:
+                    del table[name]
+
     def observe(self, name: str, value: float) -> None:
         """Record one histogram observation (e.g. a sync latency)."""
         with self._lock:
