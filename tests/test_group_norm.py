@@ -94,3 +94,21 @@ def test_dispatch_and_input_guards():
         fused_group_norm(x, scale, bias, 3)
     with pytest.raises(NotImplementedError, match="NHWC"):
         fused_group_norm(x[0], scale, bias, 4)
+
+
+def test_large_mean_inputs_match_reference():
+    """Variance must be computed two-pass (E[(x-mean)^2]): the one-pass
+    E[x^2]-mean^2 form cancels catastrophically in f32 when |mean| >>
+    std, which standard-normal test data never exposes."""
+    rng = np.random.default_rng(5)
+    x = (1000.0 + 0.1 * _rand(rng, (2, 8, 8, 32))).astype(jnp.float32)
+    scale = _rand(rng, (32,))
+    bias = _rand(rng, (32,))
+    yk = fused_group_norm_interpret(x, scale, bias, 8)
+    yr = reference_group_norm(x, scale, bias, 8)
+    # ~3e-3 residual is the f32 limit of (x - mean) itself at mean~1e3
+    # (shared by ANY implementation, including flax); the one-pass
+    # variance form this test guards against was wrong by >1e-1
+    np.testing.assert_allclose(
+        np.asarray(yk), np.asarray(yr), atol=1e-2, rtol=1e-2
+    )

@@ -271,3 +271,41 @@ def test_fit_loop_throughput_matches_scanned_steps():
         f"fit loop {fit*1000:.1f} ms/step vs raw {raw*1000:.1f} ms/step — "
         "input pipeline is serializing against device compute again?"
     )
+
+
+def test_scan_steps_chunked_loop_matches_per_step():
+    """The multi-step device loop (TrainConfig.scan_steps: k steps per
+    jitted lax.scan dispatch) must be a pure dispatch optimization —
+    identical trajectory, same history boundaries, checkpoint cadence
+    respected."""
+    mesh = make_mesh(data=8)
+    task = mlp.make_task()
+    runs = {}
+    for scan in (1, 4, 5):  # 5 does not divide log_every: chunks clamp
+        cfg = TrainConfig(
+            steps=12, learning_rate=1e-2, log_every=6, seed=3,
+            scan_steps=scan,
+        )
+        _state, hist = Trainer(task, cfg, mesh).fit()
+        runs[scan] = [(h["step"], round(h["loss"], 6)) for h in hist]
+    assert runs[1] == runs[4] == runs[5], runs
+
+
+def test_scan_steps_respects_checkpoint_boundary(tmp_path):
+    mesh = make_mesh(data=8)
+    task = mlp.make_task()
+    cfg = TrainConfig(
+        steps=8, learning_rate=1e-2, log_every=8, seed=0,
+        checkpoint_every=3, checkpoint_dir=str(tmp_path / "ck"),
+        scan_steps=4,
+    )
+    trainer = Trainer(task, cfg, mesh)
+    _state, _hist = trainer.fit()
+    from tfk8s_tpu.runtime.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path / "ck"))
+    # saves must exist at the exact cadence steps (3, 6) plus the final 8
+    # — if the chunk clamp broke, the cadence saves would land elsewhere
+    # (or vanish) even though the end-of-fit save still writes step 8
+    assert ck.all_steps() == [3, 6, 8], ck.all_steps()
+    ck.close()

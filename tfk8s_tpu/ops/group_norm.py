@@ -74,20 +74,20 @@ def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, rstd_ref,
 
     m_cg, m_gc = _group_matrices(c, groups)
     s = jnp.sum(xf, axis=0, keepdims=True)          # [1, C]
-    ss = jnp.sum(xf * xf, axis=0, keepdims=True)    # [1, C]
-    gsum = jnp.dot(s, m_cg, preferred_element_type=jnp.float32)    # [1, G]
-    gss = jnp.dot(ss, m_cg, preferred_element_type=jnp.float32)    # [1, G]
-    mean = gsum / n
-    var = gss / n - mean * mean
-    rstd = lax.rsqrt(var + eps)
-
+    mean = jnp.dot(s, m_cg, preferred_element_type=jnp.float32) / n  # [1, G]
     mean_c = jnp.dot(mean, m_gc, preferred_element_type=jnp.float32)  # [1, C]
+    # two-pass variance E[(x-mean)^2] over the VMEM-resident tile (an
+    # extra VPU sweep, zero extra HBM): the one-pass E[x^2]-mean^2 form
+    # cancels catastrophically in f32 when |mean| >> std, which would
+    # break the flax-interchangeability claim on large-mean activations
+    d = xf - mean_c
+    ss = jnp.sum(d * d, axis=0, keepdims=True)      # [1, C]
+    var = jnp.dot(ss, m_cg, preferred_element_type=jnp.float32) / n   # [1, G]
+    rstd = lax.rsqrt(var + eps)
     rstd_c = jnp.dot(rstd, m_gc, preferred_element_type=jnp.float32)  # [1, C]
     gamma = scale_ref[0].reshape(1, c).astype(jnp.float32)
     beta = bias_ref[0].reshape(1, c).astype(jnp.float32)
-    a = gamma * rstd_c
-    b = beta - mean_c * a
-    y = xf * a + b
+    y = d * rstd_c * gamma + beta
     if relu:
         y = jnp.maximum(y, 0.0)
     y_ref[0] = y.astype(y_ref.dtype).reshape(x_ref.shape[1:])

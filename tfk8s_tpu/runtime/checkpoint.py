@@ -58,6 +58,15 @@ class Checkpointer:
             self._mgr.wait_until_finished()
         log.info("saved checkpoint step=%d -> %s", step, self.directory)
 
+    def all_steps(self) -> list:
+        """Every retained checkpoint step, ascending (cadence assertions
+        and retention inspection)."""
+        if not self.enabled:
+            return []
+        if hasattr(self._mgr, "reload"):
+            self._mgr.reload()
+        return sorted(self._mgr.all_steps())
+
     def latest_step(self) -> Optional[int]:
         if not self.enabled:
             return None

@@ -312,6 +312,7 @@ class LocalKubelet:
     def _set_phase(
         self, pod_key: str, uid: str, phase: PodPhase, message: str = "",
         exit_code=None, log_tail: Optional[List[str]] = None,
+        training: Optional[Dict[str, float]] = None,
     ) -> bool:
         ns, name = pod_key.split("/", 1)
         for _ in range(5):
@@ -327,6 +328,8 @@ class LocalKubelet:
             current.status.host = self.name
             if log_tail is not None:
                 current.status.log_tail = log_tail
+            if training:
+                current.status.training = dict(training)
             try:
                 self.cs.pods(ns).update_status(current)
                 return True
@@ -359,11 +362,19 @@ class LocalKubelet:
                     raise RuntimeError(f"injected failure {n + 1}/{fail_times}")
             fn = registry.resolve(container.entrypoint)
             registry.call(fn, env, pod_stop)
+            from tfk8s_tpu.runtime import progress as _progress
+
+            # the terminal write carries the FINAL progress report too —
+            # the 1s flusher usually misses the report fired right before
+            # the entrypoint returns (e.g. the step==steps boundary)
             self._set_phase(
-                key, uid, PodPhase.SUCCEEDED, exit_code=0, log_tail=list(buf)
+                key, uid, PodPhase.SUCCEEDED, exit_code=0,
+                log_tail=list(buf), training=_progress.snapshot(ident),
             )
         except Exception as e:  # noqa: BLE001 — container failure, not ours
             log.info("%s: pod %s failed: %s", self.name, key, e)
+            from tfk8s_tpu.runtime import progress as _progress
+
             try:
                 self._set_phase(
                     key,
@@ -372,6 +383,7 @@ class LocalKubelet:
                     message=f"{type(e).__name__}: {e}",
                     exit_code=1,
                     log_tail=list(buf),
+                    training=_progress.snapshot(ident),
                 )
             except Exception:  # noqa: BLE001 — apiserver gone (teardown):
                 # the node lease will go stale and the controller (if any

@@ -168,7 +168,13 @@ class TPUJobController:
         # are operator-visible without touching the reconcile path.
         if new.status.training and new.status.training != old.status.training:
             job = new.metadata.labels.get(L.JOB_NAME)
+            # only for a LIVE owner: a late pod update delivered after
+            # _finalize pruned the job's series must not resurrect them
+            # (the job is gone or carries its deletion timestamp by then)
+            owner = None
             if job:
+                owner = self.jobs.get_by_key(f"{new.metadata.namespace}/{job}")
+            if owner is not None and owner.metadata.deletion_timestamp is None:
                 series = f"tpujob.training.{new.metadata.namespace}.{job}"
                 for k in ("steps_per_sec", "examples_per_sec", "step"):
                     if k in new.status.training:
