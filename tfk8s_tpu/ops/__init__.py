@@ -4,3 +4,4 @@ doesn't already win on. The reference has no numerical code at all
 the build's TPU-native data-plane addition."""
 
 from tfk8s_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from tfk8s_tpu.ops.group_norm import fused_group_norm  # noqa: F401

@@ -28,6 +28,7 @@ from tfk8s_tpu.api.types import Pod, PodPhase
 from tfk8s_tpu.client.clientset import Clientset
 from tfk8s_tpu.client.informer import ResourceEventHandler, SharedIndexInformer
 from tfk8s_tpu.client.store import Conflict, NotFound
+from tfk8s_tpu.runtime import progress as _progress
 from tfk8s_tpu.runtime import registry
 from tfk8s_tpu.utils.logging import get_logger
 
@@ -198,8 +199,6 @@ class LocalKubelet:
         status, so `logs` works mid-run (final flush rides the terminal
         _set_phase). Runs OUTSIDE the logging handler — a flush that
         itself logs (update conflicts) must not recurse into capture."""
-        from tfk8s_tpu.runtime import progress as _progress
-
         while self._stop is not None and not self._stop.is_set():
             try:
                 with self._lock:
@@ -362,8 +361,6 @@ class LocalKubelet:
                     raise RuntimeError(f"injected failure {n + 1}/{fail_times}")
             fn = registry.resolve(container.entrypoint)
             registry.call(fn, env, pod_stop)
-            from tfk8s_tpu.runtime import progress as _progress
-
             # the terminal write carries the FINAL progress report too —
             # the 1s flusher usually misses the report fired right before
             # the entrypoint returns (e.g. the step==steps boundary)
@@ -373,8 +370,6 @@ class LocalKubelet:
             )
         except Exception as e:  # noqa: BLE001 — container failure, not ours
             log.info("%s: pod %s failed: %s", self.name, key, e)
-            from tfk8s_tpu.runtime import progress as _progress
-
             try:
                 self._set_phase(
                     key,
@@ -393,8 +388,6 @@ class LocalKubelet:
             log.debug("%s", traceback.format_exc())
         finally:
             self._log_router.unregister(ident)
-            from tfk8s_tpu.runtime import progress as _progress
-
             _progress.clear(ident)
             with self._lock:
                 self._claimed.pop((key, uid), None)

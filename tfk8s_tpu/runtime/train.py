@@ -341,6 +341,7 @@ class Trainer:
 
         self._chunk_fns: Dict[int, Any] = {}
         self.batch_shardings = self._batch_shardings()
+        self.stacked_batch_shardings = self._stacked_batch_shardings()
         self._step_fn = jax.jit(
             _step,
             in_shardings=(self.state_shardings, self.batch_shardings, None),
@@ -427,7 +428,7 @@ class Trainer:
                 chunk,
                 in_shardings=(
                     self.state_shardings,
-                    self._stacked_batch_shardings(),
+                    self.stacked_batch_shardings,
                     None,
                 ),
                 out_shardings=(self.state_shardings, None),
@@ -469,7 +470,7 @@ class Trainer:
         history: List[Dict[str, float]] = []
         start_step = int(state.step)
         batch_shardings = self.batch_shardings
-        stacked_shardings = self._stacked_batch_shardings()
+        stacked_shardings = self.stacked_batch_shardings
 
         prof_start = start_step + cfg.profile_skip if cfg.profile_dir else -1
         prof_stop = prof_start + cfg.profile_steps
@@ -544,9 +545,14 @@ class Trainer:
                     state, ys = self._chunk_fn(k)(state, batch, base_key)
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], ys)
                 step += k
-                inflight.append(metrics["loss"])
-                if len(inflight) > max_inflight:
-                    jax.block_until_ready(inflight.popleft())
+                # the window counts STEPS, not dispatches: a k-step chunk
+                # holds k staged batches, so it weighs k against the bound
+                inflight.append((metrics["loss"], k))
+                inflight_steps = sum(w for _, w in inflight)
+                while inflight and inflight_steps > max_inflight:
+                    old_loss, w = inflight.popleft()
+                    inflight_steps -= w
+                    jax.block_until_ready(old_loss)
                 if profiling and step >= prof_stop:
                     jax.block_until_ready(metrics["loss"])
                     jax.profiler.stop_trace()
