@@ -274,3 +274,113 @@ class TestHTTPConformance:
         assert by_name["tpujobs"]["kind"] == "TPUJob"
         assert "watch" in by_name["tpujobs"]["verbs"]
         assert "tpujobs/status" in by_name
+
+
+class TestCoreKindsWire:
+    """The core kinds (Pod/Service/Lease/Event) ride the same codec as
+    the CRD: camelCase, their own apiVersion defaults, lossless decode."""
+
+    def test_pod_wire_roundtrip_and_casing(self):
+        from tfk8s_tpu.api.types import (
+            ContainerSpec, Pod, PodSpec, PodStatus, PodPhase,
+        )
+
+        pod = Pod(
+            metadata=ObjectMeta(name="w-0", namespace="ml", resource_version=9),
+            spec=PodSpec(
+                containers=[ContainerSpec(entrypoint="m:train")],
+                node_selector={"tfk8s.dev/host": "h0"},
+            ),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                host="node-a",
+                log_tail=["line1"],
+                training={"steps_per_sec": 2.5},
+            ),
+        )
+        w = serde.to_wire(pod)
+        assert w["apiVersion"] == "v1" and w["kind"] == "Pod"
+        assert w["spec"]["nodeSelector"] == {"tfk8s.dev/host": "h0"}
+        assert w["spec"]["restartPolicy"] == "Never"
+        assert w["status"]["logTail"] == ["line1"]
+        assert w["status"]["training"] == {"steps_per_sec": 2.5}
+        assert serde.decode_object(w) == pod
+
+    def test_lease_and_event_wire_roundtrip(self):
+        from tfk8s_tpu.api.types import Event, Lease, LeaseSpec
+
+        lease = Lease(
+            metadata=ObjectMeta(name="node-a"),
+            spec=LeaseSpec(
+                holder="op-1", lease_duration_s=15.0,
+                acquire_time=1700000000.5, renew_time=1700000009.25,
+            ),
+        )
+        w = serde.to_wire(lease)
+        assert w["apiVersion"] == "coordination/v1"
+        assert w["spec"]["leaseDurationS"] == 15.0
+        # *_time fields serialize RFC3339 and decode back losslessly
+        assert w["spec"]["renewTime"].endswith("Z")
+        assert serde.decode_object(w) == lease
+
+        ev = Event(
+            metadata=ObjectMeta(name="tpujob.j1.jobcreated"),
+            involved_kind="TPUJob", involved_key="default/j1",
+            reason="JobCreated", count=3,
+            first_timestamp=1700000000.0, last_timestamp=1700000100.0,
+        )
+        w = serde.to_wire(ev)
+        assert w["involvedKind"] == "TPUJob"
+        assert w["firstTimestamp"].endswith("Z")
+        assert serde.decode_object(w) == ev
+
+
+class TestStatusSubresource:
+    def test_status_put_k8s_casing(self, api):
+        """PUT .../{name}/status with a k8s-cased body updates ONLY the
+        status (the subresource contract) and answers in wire form."""
+        base = f"{api.url}/apis/{API_VERSION}/namespaces/ml/tpujobs"
+        code, created = _http("POST", base, serde.to_wire(full_job()))
+        assert code == 201
+
+        obj = json.loads(json.dumps(created))
+        obj["status"]["gangRestarts"] = 7
+        # a spec mutation riding along in the body must NOT be persisted
+        # by the status subresource — that's the isolation contract
+        obj["spec"]["runPolicy"]["backoffLimit"] = 99
+        code, updated = _http("PUT", f"{base}/bert-mlm/status", obj)
+        assert code == 200
+        assert updated["status"]["gangRestarts"] == 7
+        assert updated["apiVersion"] == API_VERSION
+        code, got = _http("GET", f"{base}/bert-mlm")
+        assert got["status"]["gangRestarts"] == 7
+        assert got["spec"]["runPolicy"]["backoffLimit"] == 3, (
+            "status PUT must not update spec"
+        )
+
+    def test_watch_deleted_event_wire_shape(self, api):
+        base = f"{api.url}/apis/{API_VERSION}/namespaces/ml/tpujobs"
+        job = full_job()
+        # no finalizers: with one, DELETE only MARKS the object
+        # (deletionTimestamp -> a MODIFIED event) until a controller
+        # strips it — here we want the immediate-removal path
+        job.metadata.finalizers = []
+        code, created = _http("POST", base, serde.to_wire(job))
+        assert code == 201
+        code, _ = _http("DELETE", f"{base}/bert-mlm")
+        assert code == 200
+        url = f"{api.url}/apis/{API_VERSION}/tpujobs?watch=1&resourceVersion=0"
+        resp = urllib.request.urlopen(url, timeout=10)
+        try:
+            seen = []
+            for raw in resp:
+                ev = json.loads(raw)
+                if ev.get("type") == "HEARTBEAT":
+                    break
+                seen.append(ev)
+            types = [e["type"] for e in seen]
+            assert types == ["ADDED", "DELETED"], types
+            assert seen[-1]["object"]["kind"] == "TPUJob"
+            assert seen[-1]["object"]["metadata"]["name"] == "bert-mlm"
+        finally:
+            resp.close()
