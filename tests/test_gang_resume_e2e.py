@@ -79,13 +79,21 @@ def cluster():
 
 
 
-def test_gang_restart_resumes_training_from_checkpoint(cluster, tmp_path):
+def test_gang_restart_resumes_training_from_checkpoint(cluster, tmp_path, monkeypatch):
     cs, _ctrl, _stop = cluster
     name = "resume-e2e"
+    # The deployment story writes checkpoints to GCS (SURVEY.md §5 "async
+    # checkpoint to GCS"): the job carries a gs://-SHAPED URI and the
+    # local fake object store (TFK8S_GCS_FAKE_ROOT) maps it onto tmp_path
+    # — proving the resume contract never mangles scheme'd paths (the r3
+    # abspath bug) while staying hermetic.
+    monkeypatch.setenv("TFK8S_GCS_FAKE_ROOT", str(tmp_path / "gcs"))
     job = TPUJob(
         metadata=ObjectMeta(
             name=name,
-            annotations={CHECKPOINT_DIR_ANNOTATION: str(tmp_path / "ckpt")},
+            annotations={
+                CHECKPOINT_DIR_ANNOTATION: f"gs://tfk8s-test-bucket/ckpt/{name}"
+            },
         ),
         spec=TPUJobSpec(
             replica_specs={
@@ -137,3 +145,6 @@ def test_gang_restart_resumes_training_from_checkpoint(cluster, tmp_path):
     # the resumed run finished the full schedule and hit the target
     assert obs["final"]["step"] == _FULL_STEPS
     assert obs["final"]["accuracy"] >= 0.9
+
+    # the gs:// URI resolved into the fake object store, bucket/key intact
+    assert (tmp_path / "gcs" / "tfk8s-test-bucket" / "ckpt" / name).is_dir()

@@ -1,0 +1,353 @@
+"""The secured apiserver: TLS serving + bearer/mTLS authentication — the
+repo's equivalent of the reference's whole client-stack purpose
+(``rest.Config`` carrying certs/credentials to an HTTPS apiserver,
+k8s-operator.md:93-97, images/tf5-tf6). Proves the north-star
+prerequisite: a GKE apiserver is always HTTPS + authn, so the operator,
+kubelet, and CLI must reconcile over a secured wire — and anonymous
+requests must bounce 401/403.
+"""
+
+import base64
+import json
+import ssl
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.types import (
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from tfk8s_tpu.client.apiserver import APIServer, AuthConfig, TLSServerConfig, User
+from tfk8s_tpu.client.clientset import Clientset, RESTConfig
+from tfk8s_tpu.client.remote import (
+    Kubeconfig,
+    RemoteStore,
+    build_ssl_context,
+    clientset_from_kubeconfig,
+    load_kubeconfig,
+    store_from_kubeconfig,
+)
+from tfk8s_tpu.client.store import ClusterStore, Forbidden, Unauthorized
+from tfk8s_tpu.client.tlsutil import cert_common_name, generate_ca, issue_cert
+
+TOKEN = "sekret-operator-token"
+RO_TOKEN = "sekret-readonly-token"
+
+
+def make_job(name, entrypoint="test.echo"):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint=entrypoint)
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """One CA + server/client certs for the module (EC keygen is cheap but
+    no reason to repeat it per test)."""
+    d = tmp_path_factory.mktemp("pki")
+    ca = generate_ca()
+    server_pair = issue_cert(ca, "tfk8s-apiserver")
+    client_pair = issue_cert(ca, "cert-user", client=True)
+    ca_path, _ = ca.write(str(d), "ca")
+    cert_path, key_path = server_pair.write(str(d), "apiserver")
+    ccert_path, ckey_path = client_pair.write(str(d), "client")
+    return {
+        "ca": ca, "ca_path": ca_path,
+        "cert_path": cert_path, "key_path": key_path,
+        "client_cert_path": ccert_path, "client_key_path": ckey_path,
+    }
+
+
+@pytest.fixture()
+def secured(pki):
+    """HTTPS apiserver requiring auth: bearer tokens + client-cert CA."""
+    server = APIServer(
+        ClusterStore(),
+        port=0,
+        tls=TLSServerConfig(
+            pki["cert_path"], pki["key_path"], client_ca_file=pki["ca_path"]
+        ),
+        auth=AuthConfig(
+            tokens={TOKEN: User("operator"), RO_TOKEN: User("viewer", readonly=True)}
+        ),
+    )
+    server.serve_background()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def authed_store(server, pki, token=TOKEN):
+    return RemoteStore(
+        server.url,
+        token=token,
+        ssl_context=build_ssl_context(
+            Kubeconfig(server=server.url, certificate_authority=pki["ca_path"])
+        ),
+    )
+
+
+class TestPKI:
+    def test_issued_chain_verifies(self, pki):
+        # the CA-pinned client context accepts the issued server cert
+        ctx = ssl.create_default_context(cafile=pki["ca_path"])
+        assert ctx.cert_store_stats()["x509_ca"] == 1
+        assert cert_common_name(pki["ca"].cert_pem) == "tfk8s-ca"
+
+    def test_key_files_are_private(self, pki):
+        import os
+
+        assert os.stat(pki["key_path"]).st_mode & 0o777 == 0o600
+
+
+class TestSecuredWire:
+    def test_https_crud_and_watch_with_bearer_token(self, secured, pki):
+        store = authed_store(secured, pki)
+        assert secured.url.startswith("https://")
+        store.create(make_job("tls-a"))
+        assert store.get("TPUJob", "default", "tls-a").metadata.name == "tls-a"
+        w = store.watch("TPUJob", since_rv=0)
+        try:
+            ev = w.next(timeout=5)
+            assert ev.object.metadata.name == "tls-a"
+        finally:
+            store.stop_watch(w)
+
+    def test_anonymous_rejected_401(self, secured, pki):
+        anon = authed_store(secured, pki, token=None)
+        with pytest.raises(Unauthorized):
+            anon.list("TPUJob")
+        with pytest.raises(Unauthorized):
+            anon.create(make_job("nope"))
+        with pytest.raises(Unauthorized):
+            anon.watch("TPUJob")
+
+    def test_unknown_token_rejected_401(self, secured, pki):
+        with pytest.raises(Unauthorized):
+            authed_store(secured, pki, token="wrong").list("TPUJob")
+
+    def test_readonly_token_reads_but_cannot_write_403(self, secured, pki):
+        authed_store(secured, pki).create(make_job("ro-visible"))
+        viewer = authed_store(secured, pki, token=RO_TOKEN)
+        items, _ = viewer.list("TPUJob")
+        assert [j.metadata.name for j in items] == ["ro-visible"]
+        with pytest.raises(Forbidden):
+            viewer.create(make_job("ro-write"))
+        with pytest.raises(Forbidden):
+            viewer.delete("TPUJob", "default", "ro-visible")
+
+    def test_unauthorized_post_closes_keepalive_cleanly(self, secured, pki):
+        # the gate fires before the body is read; the server must signal
+        # Connection: close or the unread body desyncs the next request
+        import http.client
+
+        from tfk8s_tpu import API_VERSION
+        from tfk8s_tpu.api import serde
+
+        ctx = ssl.create_default_context(cafile=pki["ca_path"])
+        conn = http.client.HTTPSConnection("127.0.0.1", secured.port, context=ctx)
+        try:
+            body = json.dumps(serde.to_wire(make_job("desync"))).encode()
+            conn.request(
+                "POST",
+                f"/apis/{API_VERSION}/namespaces/default/tpujobs",
+                body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 401
+            resp.read()
+            assert resp.getheader("Connection") == "close"
+        finally:
+            conn.close()
+
+    def test_healthz_stays_open_for_probes(self, secured, pki):
+        anon = authed_store(secured, pki, token=None)
+        assert anon.healthz()
+
+    def test_client_cert_identity_mtls(self, secured, pki):
+        cfg = Kubeconfig(
+            server=secured.url,
+            certificate_authority=pki["ca_path"],
+            client_certificate=pki["client_cert_path"],
+            client_key=pki["client_key_path"],
+        )
+        store = store_from_kubeconfig(cfg)
+        store.create(make_job("mtls-a"))  # CA-verified cert CN is the user
+        assert store.get("TPUJob", "default", "mtls-a").metadata.name == "mtls-a"
+
+    def test_untrusted_server_cert_rejected(self, secured):
+        # a client pinning a DIFFERENT CA must refuse the server
+        other_ca = generate_ca(cn="rogue-ca")
+        ctx = ssl.create_default_context(cadata=other_ca.cert_pem.decode())
+        store = RemoteStore(secured.url, token=TOKEN, ssl_context=ctx)
+        from tfk8s_tpu.client.store import StoreError
+
+        with pytest.raises(StoreError, match="unreachable"):
+            store.list("TPUJob")
+
+
+class TestKubeconfigFormats:
+    def test_flat_json_with_inline_ca_and_token(self, secured, pki, tmp_path):
+        with open(pki["ca_path"]) as f:
+            ca_pem = f.read()
+        path = tmp_path / "kc.json"
+        path.write_text(json.dumps({
+            "server": secured.url,
+            "certificate_authority_data": ca_pem,
+            "token": TOKEN,
+        }))
+        cs = clientset_from_kubeconfig(str(path))
+        cs.tpujobs("default").create(make_job("kc-flat"))
+        assert secured.store.get("TPUJob", "default", "kc-flat")
+
+    def test_k8s_format_yaml_with_base64_data(self, secured, pki, tmp_path):
+        # the real kubeconfig shape: clusters/users/contexts, *-data base64
+        with open(pki["ca_path"], "rb") as f:
+            ca_b64 = base64.b64encode(f.read()).decode()
+        path = tmp_path / "kubeconfig.yaml"
+        path.write_text(
+            "apiVersion: v1\n"
+            "kind: Config\n"
+            "current-context: test\n"
+            "clusters:\n"
+            "- name: tfk8s\n"
+            "  cluster:\n"
+            f"    server: {secured.url}\n"
+            f"    certificate-authority-data: {ca_b64}\n"
+            "contexts:\n"
+            "- name: test\n"
+            "  context: {cluster: tfk8s, user: op}\n"
+            "users:\n"
+            "- name: op\n"
+            "  user:\n"
+            f"    token: {TOKEN}\n"
+        )
+        cfg = load_kubeconfig(str(path))
+        assert cfg.token == TOKEN
+        assert cfg.certificate_authority_data.startswith("-----BEGIN")
+        cs = clientset_from_kubeconfig(cfg)
+        cs.tpujobs("default").create(make_job("kc-k8s"))
+        assert secured.store.get("TPUJob", "default", "kc-k8s")
+
+    def test_flat_json_accepts_base64_data_fields(self, secured, pki, tmp_path):
+        # the *_data field convention is base64(PEM); the flat form must
+        # honor it exactly like the k8s form (raw PEM also accepted)
+        with open(pki["ca_path"], "rb") as f:
+            ca_b64 = base64.b64encode(f.read()).decode()
+        path = tmp_path / "kc-b64.json"
+        path.write_text(json.dumps({
+            "server": secured.url,
+            "certificate_authority_data": ca_b64,
+            "token": TOKEN,
+        }))
+        cs = clientset_from_kubeconfig(str(path))
+        cs.tpujobs("default").create(make_job("kc-b64"))
+        assert secured.store.get("TPUJob", "default", "kc-b64")
+
+    def test_inline_client_pair_staged_once(self, pki):
+        # rebuilding clients from the same inline credentials must reuse
+        # one staged key file, not leak a new tempdir per call
+        from tfk8s_tpu.client import remote as remote_mod
+
+        with open(pki["client_cert_path"]) as f:
+            cert_pem = f.read()
+        with open(pki["client_key_path"]) as f:
+            key_pem = f.read()
+        before = len(remote_mod._staged_dirs)
+        cfg = Kubeconfig(
+            server="https://127.0.0.1:1",
+            certificate_authority=pki["ca_path"],
+            client_certificate_data=cert_pem,
+            client_key_data=key_pem,
+        )
+        build_ssl_context(cfg)
+        build_ssl_context(cfg)
+        assert len(remote_mod._staged_dirs) == before + 1
+
+    def test_token_file_parsing(self, tmp_path):
+        p = tmp_path / "tokens.csv"
+        p.write_text(f"# static tokens\n{TOKEN},operator\n{RO_TOKEN},viewer,readonly\n")
+        auth = AuthConfig.from_token_file(str(p))
+        assert auth.tokens[TOKEN] == User("operator")
+        assert auth.tokens[RO_TOKEN].readonly
+
+
+class TestSecuredReconcileE2E:
+    """The VERDICT-r3 'done' bar: operator + kubelet + CLI reconcile a job
+    over HTTPS with a self-signed CA and a bearer token (separate HTTP
+    clients of one secured apiserver, real sockets + real TLS)."""
+
+    def test_job_succeeds_over_https(self, secured, pki, tmp_path, capsys):
+        from tfk8s_tpu.api import serde
+        from tfk8s_tpu.cmd.main import main
+        from tfk8s_tpu.cmd.options import Options
+        from tfk8s_tpu.cmd.server import Server
+        from tfk8s_tpu.runtime import registry
+        from tfk8s_tpu.runtime.kubelet import LocalKubelet
+
+        with open(pki["ca_path"]) as f:
+            ca_pem = f.read()
+        kc = tmp_path / "kubeconfig.json"
+        kc.write_text(json.dumps({
+            "server": secured.url,
+            "certificate_authority_data": ca_pem,
+            "token": TOKEN,
+        }))
+
+        ran = threading.Event()
+        registry.register("tls-e2e.echo", lambda env: ran.set())
+
+        stop = threading.Event()
+        operator = Server(Options(kubeconfig=str(kc), local_kubelet=False, workers=2))
+        operator.run(stop, block=False)
+        kubelet = LocalKubelet(
+            clientset_from_kubeconfig(str(kc)), name="tls-kubelet"
+        )
+        kubelet.run(stop)
+        try:
+            # CLI submit over the same secured wire
+            manifest = tmp_path / "job.json"
+            manifest.write_text(
+                json.dumps(serde.to_dict(make_job("tls-e2e", entrypoint="tls-e2e.echo")))
+            )
+            assert main(["submit", "--kubeconfig", str(kc), "--file", str(manifest)]) == 0
+            capsys.readouterr()
+
+            cs = clientset_from_kubeconfig(str(kc))
+            deadline = time.time() + 30
+            done = False
+            while time.time() < deadline:
+                cur = cs.tpujobs("default").get("tls-e2e")
+                if helpers.has_condition(cur.status, JobConditionType.SUCCEEDED):
+                    done = True
+                    break
+                time.sleep(0.2)
+            assert done, f"job not Succeeded over TLS; status={cur.status}"
+            assert ran.is_set()
+
+            # CLI reads it back
+            assert main(["get", "--kubeconfig", str(kc), "tls-e2e", "-o", "json"]) == 0
+            objs = json.loads(capsys.readouterr().out)
+            assert objs[0]["metadata"]["name"] == "tls-e2e"
+        finally:
+            stop.set()
+            operator.shutdown()

@@ -101,6 +101,48 @@ def test_checkpoint_resume_roundtrip(tmp_path):
     assert history2[-1]["step"] == 60
 
 
+def test_checkpoint_uri_resolution(tmp_path, monkeypatch):
+    """Scheme'd checkpoint locations survive untouched (the r3 gap:
+    abspath mangled gs:// into a local path before orbax saw it), and the
+    fake object store maps gs:// hermetically."""
+    from tfk8s_tpu.runtime.checkpoint import resolve_directory
+
+    monkeypatch.delenv("TFK8S_GCS_FAKE_ROOT", raising=False)
+    # plain paths keep historical abspath normalization
+    assert resolve_directory("rel/ckpt").endswith("/rel/ckpt")
+    assert resolve_directory("rel/ckpt").startswith("/")
+    # URIs pass through byte-for-byte
+    assert resolve_directory("gs://bucket/path/ckpt") == "gs://bucket/path/ckpt"
+    assert resolve_directory("file:///tmp/ckpt") == "file:///tmp/ckpt"
+    assert resolve_directory("s3://bucket/ckpt") == "s3://bucket/ckpt"
+    # the local fake object store maps bucket/key under the root
+    monkeypatch.setenv("TFK8S_GCS_FAKE_ROOT", str(tmp_path))
+    assert resolve_directory("gs://bucket/path/ckpt") == str(
+        tmp_path / "bucket" / "path" / "ckpt"
+    )
+
+
+def test_checkpoint_async_save_overlap(tmp_path, monkeypatch):
+    """save(wait=False) is asynchronous: it returns immediately, a
+    durability barrier is explicit (wait_until_finished), and the result
+    restores — through a gs://-shaped URI on the fake object store."""
+    import jax.numpy as jnp
+
+    from tfk8s_tpu.runtime.checkpoint import Checkpointer
+
+    monkeypatch.setenv("TFK8S_GCS_FAKE_ROOT", str(tmp_path))
+    ckpt = Checkpointer("gs://async-bucket/ckpt")
+    assert ckpt.enabled
+    state = {"w": jnp.arange(1024.0), "step": jnp.asarray(7)}
+    ckpt.save(7, state, wait=False)  # returns without the barrier
+    ckpt.wait_until_finished()
+    assert not ckpt.saving_in_progress()
+    assert ckpt.all_steps() == [7]
+    restored = ckpt.restore(state)
+    assert int(restored["step"]) == 7
+    ckpt.close()
+
+
 def test_run_task_env_contract_and_targets():
     env = {
         "TFK8S_TRAIN_STEPS": "200",

@@ -101,7 +101,10 @@ def to_wire(obj: Any) -> Any:
             if f.name == "resource_version":
                 out["resourceVersion"] = str(v)
             elif (
-                f.name.endswith(("_timestamp", "_time"))
+                # explicit registry (types.RFC3339 field metadata), not a
+                # name heuristic — a numeric duration named *_time passes
+                # through untouched (r3 advisor finding)
+                f.metadata.get("wire") == "rfc3339"
                 and isinstance(v, (int, float))
                 and not isinstance(v, bool)
             ):

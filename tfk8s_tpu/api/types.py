@@ -34,6 +34,13 @@ from typing import Any, Dict, List, Optional
 from tfk8s_tpu import API_VERSION
 
 
+# Wire-encoding marker for epoch-seconds fields that serialize as RFC3339
+# (api/serde.py to_wire). An explicit per-field registry — NOT a name
+# heuristic — so a future duration named *_time can never be silently
+# mangled into a timestamp.
+RFC3339 = {"wire": "rfc3339"}
+
+
 # ---------------------------------------------------------------------------
 # Metadata (the k8s ObjectMeta equivalent; finalizer/deletion semantics per
 # k8s-operator.md:36-43)
@@ -61,11 +68,11 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     finalizers: List[str] = field(default_factory=list)
     owner_references: List[OwnerReference] = field(default_factory=list)
-    creation_timestamp: Optional[float] = None
+    creation_timestamp: Optional[float] = field(default=None, metadata=RFC3339)
     # Deletion only *marks* the object; controllers run finalizers and then
     # clear them, at which point the store actually removes the object
     # (k8s-operator.md:36-43).
-    deletion_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = field(default=None, metadata=RFC3339)
 
     @property
     def key(self) -> str:
@@ -240,7 +247,7 @@ class Condition:
     status: bool = True
     reason: str = ""
     message: str = ""
-    last_transition_time: float = field(default_factory=time.time)
+    last_transition_time: float = field(default_factory=time.time, metadata=RFC3339)
 
 
 @dataclass
@@ -255,8 +262,8 @@ class ReplicaStatus:
 class TPUJobStatus:
     conditions: List[Condition] = field(default_factory=list)
     replica_statuses: Dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
-    start_time: Optional[float] = None
-    completion_time: Optional[float] = None
+    start_time: Optional[float] = field(default=None, metadata=RFC3339)
+    completion_time: Optional[float] = field(default=None, metadata=RFC3339)
     # Whole-gang restarts performed so far (counts against backoff_limit).
     gang_restarts: int = 0
     # Times this job's gang was evicted without failing: preempted by a
@@ -354,8 +361,8 @@ class Service:
 class LeaseSpec:
     holder: str = ""
     lease_duration_s: float = 15.0
-    acquire_time: Optional[float] = None
-    renew_time: Optional[float] = None
+    acquire_time: Optional[float] = field(default=None, metadata=RFC3339)
+    renew_time: Optional[float] = field(default=None, metadata=RFC3339)
     lease_transitions: int = 0
 
 
@@ -385,8 +392,8 @@ class Event:
     reason: str = ""
     message: str = ""
     count: int = 1
-    first_timestamp: Optional[float] = None
-    last_timestamp: Optional[float] = None
+    first_timestamp: Optional[float] = field(default=None, metadata=RFC3339)
+    last_timestamp: Optional[float] = field(default=None, metadata=RFC3339)
     api_version: str = "core/v1"
     kind: str = "Event"
 

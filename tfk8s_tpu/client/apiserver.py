@@ -34,14 +34,28 @@ client must relist). Watch responses are newline-delimited JSON events
 ``{"type": "ADDED|MODIFIED|DELETED", "object": {...}}`` streamed until the
 client disconnects, with periodic ``{"type": "HEARTBEAT"}`` lines so a dead
 peer is detected and the server-side watch reclaimed.
+
+Security (the part of the reference's client stack whose whole point is a
+*secured* apiserver — ``rest.Config`` carries TLS + credentials,
+`k8s-operator.md:93-97`, images/tf5-tf6): pass ``tls=TLSServerConfig(...)``
+to serve HTTPS (optionally verifying client certs against a CA), and
+``auth=AuthConfig(...)`` to require credentials. Authentication accepts a
+``Authorization: Bearer <token>`` header (static-token-file model) or a
+CA-verified client certificate (identity = cert CN). With auth enabled:
+no/unknown credentials → **401 Unauthorized**; a read-only identity
+attempting a write → **403 Forbidden**; ``/healthz`` stays open for
+liveness probes. Without ``auth``, requests run as ``system:anonymous``
+(the hermetic default).
 """
 
 from __future__ import annotations
 
 import json
 import socketserver
+import ssl
 import threading
 import urllib.parse
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -72,6 +86,55 @@ KIND_TO_PLURAL = {v: k for k, v in PLURALS.items()}
 _HEARTBEAT_S = 2.0
 
 
+@dataclass
+class TLSServerConfig:
+    """Serving certs. ``client_ca_file`` set → request client certificates
+    during the handshake and accept CA-verified ones as an identity (mTLS);
+    bearer tokens still work alongside."""
+
+    cert_file: str
+    key_file: str
+    client_ca_file: Optional[str] = None
+
+
+@dataclass
+class User:
+    """An authenticated caller. ``readonly`` callers get GET/watch only —
+    the minimal authorization split that makes 403 (authorized ≠
+    authenticated) real rather than theoretical."""
+
+    name: str
+    readonly: bool = False
+
+
+@dataclass
+class AuthConfig:
+    """Static-token authentication (the k8s ``--token-auth-file`` model):
+    bearer token → user. ``allow_client_certs`` additionally admits
+    mTLS-verified peers (requires ``TLSServerConfig.client_ca_file``)."""
+
+    tokens: Dict[str, User] = field(default_factory=dict)
+    allow_client_certs: bool = True
+
+    @staticmethod
+    def from_token_file(path: str) -> "AuthConfig":
+        """Parse ``token,user[,readonly]`` lines (CSV like the k8s static
+        token file; blank lines and ``#`` comments skipped)."""
+        tokens: Dict[str, User] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split(",")]
+                if len(parts) < 2:
+                    raise ValueError(f"token file line needs token,user: {line!r}")
+                tokens[parts[0]] = User(
+                    name=parts[1], readonly="readonly" in parts[2:]
+                )
+        return AuthConfig(tokens=tokens)
+
+
 class _AdmissionRejected(Exception):
     """Invalid TPUJob write — mapped to 422 Invalid by the error sender."""
 
@@ -99,6 +162,75 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # route through our logger, debug level
         log.debug("http: " + a[0], *a[1:])
 
+    def setup(self) -> None:
+        # Per-connection TLS: get_request hands us a not-yet-handshaken
+        # SSLSocket (wrapping there, handshaking here, keeps a slow or
+        # malicious peer from stalling the accept loop). Handshake errors
+        # propagate to handle_error, which logs them at debug.
+        if isinstance(self.request, ssl.SSLSocket):
+            self.request.do_handshake()
+        super().setup()
+
+    # -- authn/authz --------------------------------------------------------
+
+    def _send_status_error(
+        self, status: int, reason: str, message: str,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        body = _err_body(status, reason, message)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authenticate(self) -> Optional[User]:
+        """Resolve the caller's identity, or None (no valid credentials)."""
+        auth = self.server.auth
+        if auth is None:
+            return User("system:anonymous")
+        hdr = self.headers.get("Authorization", "")
+        if hdr.startswith("Bearer "):
+            return auth.tokens.get(hdr[len("Bearer "):].strip())
+        if auth.allow_client_certs and isinstance(self.connection, ssl.SSLSocket):
+            der = self.connection.getpeercert(binary_form=True)
+            if der:  # CA-verified during the handshake (CERT_OPTIONAL)
+                from tfk8s_tpu.client.tlsutil import cert_common_name
+
+                cn = cert_common_name(der)
+                if cn:
+                    return User(cn)
+        return None
+
+    def _gate(self, write: bool) -> Optional[User]:
+        """The 401/403 boundary: returns the caller, or None after having
+        sent the error. Anonymous/unknown credentials → 401 Unauthorized
+        (with WWW-Authenticate, per RFC 6750); an authenticated read-only
+        caller attempting a write → 403 Forbidden."""
+        user = self._authenticate()
+        if user is None or (write and user.readonly):
+            # The gate fires BEFORE the request body is read; on HTTP/1.1
+            # keep-alive the unread body bytes would be parsed as the next
+            # request line — close the connection instead of desyncing it.
+            self.close_connection = True
+            if user is None:
+                self._send_status_error(
+                    401, "Unauthorized", "authentication required",
+                    extra_headers={
+                        "WWW-Authenticate": "Bearer", "Connection": "close",
+                    },
+                )
+            else:
+                self._send_status_error(
+                    403, "Forbidden",
+                    f'user "{user.name}" cannot write (read-only credential)',
+                    extra_headers={"Connection": "close"},
+                )
+            return None
+        return user
+
     # -- plumbing -----------------------------------------------------------
 
     def _send_json(self, status: int, payload: Any) -> None:
@@ -123,12 +255,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             status, reason = 500, "InternalError"
             log.warning("apiserver 500: %s", exc)
-        body = _err_body(status, reason, str(exc))
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._send_status_error(status, reason, str(exc))
 
     def _read_body(self) -> Dict[str, Any]:
         length = int(self.headers.get("Content-Length", "0"))
@@ -164,14 +291,17 @@ class _Handler(BaseHTTPRequestHandler):
     # -- verbs --------------------------------------------------------------
 
     def do_GET(self) -> None:
+        if self.path == "/healthz":
+            # liveness probes stay credential-free (kubelet-probe parity)
+            self._send_json(200, {"status": "ok"})
+            return
+        if self._gate(write=False) is None:
+            return
         if self.path == "/apis" or self.path == "/apis/":
             self._send_json(200, self.server.discovery_doc())
             return
         if self.path.rstrip("/") == f"/apis/{API_VERSION}":
             self._send_json(200, self.server.resource_list())
-            return
-        if self.path == "/healthz":
-            self._send_json(200, {"status": "ok"})
             return
         route = self._route()
         if route is None:
@@ -218,6 +348,8 @@ class _Handler(BaseHTTPRequestHandler):
             raise _AdmissionRejected("; ".join(errs))
 
     def do_POST(self) -> None:
+        if self._gate(write=True) is None:
+            return
         route = self._route()
         if route is None:
             self._send_json(404, {"reason": "NotFound", "message": self.path})
@@ -234,6 +366,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_store_error(e)
 
     def do_PUT(self) -> None:
+        if self._gate(write=True) is None:
+            return
         route = self._route()
         if route is None or route[2] is None:
             self._send_json(404, {"reason": "NotFound", "message": self.path})
@@ -270,6 +404,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_store_error(e)
 
     def do_DELETE(self) -> None:
+        if self._gate(write=True) is None:
+            return
         route = self._route()
         if route is None or route[2] is None:
             self._send_json(404, {"reason": "NotFound", "message": self.path})
@@ -324,9 +460,10 @@ def _parse_selector(raw: str) -> Dict[str, str]:
 
 
 class APIServer(ThreadingHTTPServer):
-    """Threaded HTTP apiserver over one ClusterStore. ``port=0`` binds an
+    """Threaded HTTP(S) apiserver over one ClusterStore. ``port=0`` binds an
     ephemeral port (tests); ``serve_background()`` runs on a daemon thread
-    and returns the bound port."""
+    and returns the bound port. ``tls``/``auth`` secure the wire (module
+    docstring)."""
 
     daemon_threads = True
     # watches hold sockets open; allow plenty of concurrent streams
@@ -338,11 +475,44 @@ class APIServer(ThreadingHTTPServer):
         host: str = "127.0.0.1",
         port: int = 0,
         admission: bool = True,
+        tls: Optional[TLSServerConfig] = None,
+        auth: Optional[AuthConfig] = None,
     ):
         self.store = store
         self.admission = admission
+        self.auth = auth
         self.stopping = threading.Event()
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if tls is not None:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls.cert_file, tls.key_file)
+            if tls.client_ca_file:
+                ctx.load_verify_locations(tls.client_ca_file)
+                # OPTIONAL, not REQUIRED: bearer-token clients carry no
+                # cert; a presented cert must still verify against the CA
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            self._ssl_ctx = ctx
         super().__init__((host, port), _Handler)
+
+    def get_request(self):
+        sock, addr = self.socket.accept()
+        if self._ssl_ctx is not None:
+            # wrap here, handshake in the handler thread (_Handler.setup)
+            sock = self._ssl_ctx.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False
+            )
+        return sock, addr
+
+    def handle_error(self, request, client_address) -> None:  # type: ignore[override]
+        # TLS handshake failures from probes/misconfigured clients are
+        # operationally normal; keep them off stderr.
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ssl.SSLError, ConnectionError, TimeoutError, OSError)):
+            log.debug("connection from %s failed: %s", client_address, exc)
+            return
+        super().handle_error(request, client_address)
 
     @property
     def port(self) -> int:
@@ -350,7 +520,8 @@ class APIServer(ThreadingHTTPServer):
 
     @property
     def url(self) -> str:
-        return f"http://{self.server_address[0]}:{self.port}"
+        scheme = "https" if self._ssl_ctx is not None else "http"
+        return f"{scheme}://{self.server_address[0]}:{self.port}"
 
     def discovery_doc(self) -> Dict[str, Any]:
         # metav1.APIGroupList, what `kubectl api-versions` reads at /apis

@@ -11,9 +11,16 @@ run unchanged against it, which is the swap the reference performs with
 ``clientcmd.BuildConfigFromFlags → NewForConfig``
 (k8s-operator.md:92-102, images/tf4-tf6).
 
-Kubeconfig: a small JSON file ``{"server": "http://host:port", "qps": ...,
-"burst": ...}`` — :func:`load_kubeconfig` + :func:`clientset_from_kubeconfig`
-mirror the reference's kubeconfig-flag path (`k8s-operator.md:206-207`).
+Kubeconfig: :func:`load_kubeconfig` accepts BOTH a small flat JSON file
+``{"server": "http://host:port", "qps": ..., "token": ..., ...}`` and a
+real Kubernetes kubeconfig (YAML or JSON: clusters/users/contexts with
+``certificate-authority-data`` etc.) — the reference's kubeconfig-flag
+path (`k8s-operator.md:206-207`, ``clientcmd.BuildConfigFromFlags`` at
+:93). Credentials ride every request the way ``rest.Config`` carries
+them (images/tf5-tf6): the CA (path or inline PEM) pins the server cert,
+``token`` becomes ``Authorization: Bearer``, and a client cert/key pair
+is presented for mTLS; ``user_agent`` is the DefaultKubernetesUserAgent
+equivalent.
 
 Watch streams: one long-lived HTTP response per watch, newline-delimited
 JSON events pumped into a :class:`~tfk8s_tpu.client.store.Watch` by a
@@ -25,8 +32,14 @@ relist-on-Gone works identically across the wire.
 
 from __future__ import annotations
 
+import atexit
+import base64
 import json
+import os
+import shutil
 import socket
+import ssl
+import tempfile
 import threading
 import urllib.error
 import urllib.parse
@@ -42,9 +55,11 @@ from tfk8s_tpu.client.store import (
     AlreadyExists,
     Conflict,
     EventType,
+    Forbidden,
     Gone,
     NotFound,
     StoreError,
+    Unauthorized,
     Watch,
     WatchEvent,
 )
@@ -62,6 +77,10 @@ _WATCH_READ_TIMEOUT_S = 10.0
 
 
 def _map_error(status: int, reason: str, message: str) -> StoreError:
+    if status == 401:
+        return Unauthorized(message)
+    if status == 403:
+        return Forbidden(message)
     if status == 404:
         return NotFound(message)
     if status == 409 and reason == "AlreadyExists":
@@ -114,11 +133,25 @@ class RemoteWatch(Watch):
 
 
 class RemoteStore:
-    """ClusterStore-shaped facade over the HTTP apiserver."""
+    """ClusterStore-shaped facade over the HTTP(S) apiserver.
 
-    def __init__(self, base_url: str, timeout: float = _TIMEOUT_S):
+    ``token`` rides as ``Authorization: Bearer`` on every request;
+    ``ssl_context`` carries the CA pin and any client cert (build one from
+    a kubeconfig with :func:`build_ssl_context`)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = _TIMEOUT_S,
+        token: Optional[str] = None,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        user_agent: str = "tfk8s-tpu-operator",
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        self.ssl_context = ssl_context
+        self.user_agent = user_agent
 
     # -- request plumbing ---------------------------------------------------
 
@@ -144,13 +177,17 @@ class RemoteStore:
         if query:
             url += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {},
-        )
+        headers: Dict[str, str] = {"User-Agent": self.user_agent}
+        if data:
+            headers["Content-Type"] = "application/json"
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
             resp = urllib.request.urlopen(
-                req, timeout=_WATCH_READ_TIMEOUT_S if stream else self.timeout
+                req,
+                timeout=_WATCH_READ_TIMEOUT_S if stream else self.timeout,
+                context=self.ssl_context,
             )
         except urllib.error.HTTPError as e:
             payload = {}
@@ -244,22 +281,153 @@ class RemoteStore:
 
 @dataclass
 class Kubeconfig:
-    """Minimal kubeconfig: where the apiserver lives + client limits."""
+    """The ``rest.Config`` equivalent: where the apiserver lives, the
+    credentials to present, and client limits. CA/client-cert material may
+    be a file path or inline PEM (the ``*-data`` kubeconfig fields)."""
 
     server: str
     qps: float = 50.0
     burst: int = 100
     user_agent: str = "tfk8s-tpu-operator"
+    token: str = ""
+    certificate_authority: str = ""  # path to CA bundle (PEM)
+    certificate_authority_data: str = ""  # inline PEM
+    client_certificate: str = ""  # path (PEM)
+    client_key: str = ""  # path (PEM)
+    client_certificate_data: str = ""  # inline PEM
+    client_key_data: str = ""  # inline PEM
+    insecure_skip_tls_verify: bool = False
+
+
+def _b64_or_pem(value: str) -> str:
+    """kubeconfig ``*-data`` fields are base64(PEM); accept raw PEM too."""
+    if value.lstrip().startswith("-----BEGIN"):
+        return value
+    return base64.b64decode(value).decode()
+
+
+def _from_k8s_kubeconfig(data: Dict[str, Any]) -> Kubeconfig:
+    """Parse the real kubeconfig shape (clusters/users/contexts +
+    current-context), honoring ``*-data`` inline credentials."""
+    by_name = lambda items, key: {i["name"]: i[key] for i in items or []}  # noqa: E731
+    clusters = by_name(data.get("clusters"), "cluster")
+    users = by_name(data.get("users"), "user")
+    contexts = by_name(data.get("contexts"), "context")
+    ctx_name = data.get("current-context") or next(iter(contexts), "")
+    ctx = contexts.get(ctx_name, {})
+    cluster = clusters.get(ctx.get("cluster", ""), next(iter(clusters.values()), {}))
+    user = users.get(ctx.get("user", ""), next(iter(users.values()), {}))
+    return Kubeconfig(
+        server=cluster["server"],
+        certificate_authority=cluster.get("certificate-authority", ""),
+        certificate_authority_data=_b64_or_pem(
+            cluster.get("certificate-authority-data", "") or ""
+        ),
+        insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify", False)),
+        token=user.get("token", ""),
+        client_certificate=user.get("client-certificate", ""),
+        client_key=user.get("client-key", ""),
+        client_certificate_data=_b64_or_pem(user.get("client-certificate-data", "") or ""),
+        client_key_data=_b64_or_pem(user.get("client-key-data", "") or ""),
+    )
 
 
 def load_kubeconfig(path: str) -> Kubeconfig:
+    """Load either format: a real kubeconfig (YAML/JSON with ``clusters``)
+    or the flat JSON dev form."""
     with open(path) as f:
-        data = json.load(f)
+        raw = f.read()
+    try:
+        data = json.loads(raw)
+    except ValueError:
+        import yaml  # kubeconfigs in the wild are YAML
+
+        data = yaml.safe_load(raw)
+    if "clusters" in data:
+        return _from_k8s_kubeconfig(data)
     return Kubeconfig(
         server=data["server"],
         qps=float(data.get("qps", 50.0)),
         burst=int(data.get("burst", 100)),
         user_agent=data.get("user_agent", "tfk8s-tpu-operator"),
+        token=data.get("token", ""),
+        certificate_authority=data.get("certificate_authority", ""),
+        # *_data fields accept base64(PEM) or raw PEM in BOTH formats —
+        # the field name mirrors the k8s convention, so honor it here too
+        certificate_authority_data=_b64_or_pem(
+            data.get("certificate_authority_data", "") or ""
+        ),
+        client_certificate=data.get("client_certificate", ""),
+        client_key=data.get("client_key", ""),
+        client_certificate_data=_b64_or_pem(
+            data.get("client_certificate_data", "") or ""
+        ),
+        client_key_data=_b64_or_pem(data.get("client_key_data", "") or ""),
+        insecure_skip_tls_verify=bool(data.get("insecure_skip_tls_verify", False)),
+    )
+
+
+def build_ssl_context(cfg: Kubeconfig) -> Optional[ssl.SSLContext]:
+    """TLS client context from kubeconfig credentials: CA pin (path or
+    inline PEM) + optional client cert/key for mTLS. Returns None for
+    plain-HTTP servers. Server certs must carry the host as a SAN
+    (hostname verification stays ON unless insecure_skip_tls_verify)."""
+    if not cfg.server.startswith("https"):
+        return None
+    ctx = ssl.create_default_context(
+        cafile=cfg.certificate_authority or None,
+        cadata=cfg.certificate_authority_data or None,
+    )
+    if cfg.insecure_skip_tls_verify:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    if cfg.client_certificate and cfg.client_key:
+        ctx.load_cert_chain(cfg.client_certificate, cfg.client_key)
+    elif cfg.client_certificate_data and cfg.client_key_data:
+        ctx.load_cert_chain(
+            *_stage_client_pair(cfg.client_certificate_data, cfg.client_key_data)
+        )
+    return ctx
+
+
+# load_cert_chain needs files; inline PEM pairs are staged into private
+# tempdirs ONCE per distinct pair (rebuilding clients must not leak a new
+# key file per call) and removed at interpreter exit.
+_staged_pairs: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_staged_dirs: List[str] = []
+
+
+def _stage_client_pair(cert_pem: str, key_pem: str) -> Tuple[str, str]:
+    pair = (cert_pem, key_pem)
+    if pair not in _staged_pairs:
+        d = tempfile.mkdtemp(prefix="tfk8s-client-cert-")
+        cert_path = os.path.join(d, "client.crt")
+        key_path = os.path.join(d, "client.key")
+        with open(cert_path, "w") as f:
+            f.write(cert_pem)
+        with open(key_path, "w") as f:
+            f.write(key_pem)
+        os.chmod(key_path, 0o600)  # kubeconfig-credential discipline
+        _staged_pairs[pair] = (cert_path, key_path)
+        _staged_dirs.append(d)
+    return _staged_pairs[pair]
+
+
+@atexit.register
+def _cleanup_staged_pairs() -> None:
+    for d in _staged_dirs:
+        shutil.rmtree(d, ignore_errors=True)
+    _staged_dirs.clear()
+    _staged_pairs.clear()
+
+
+def store_from_kubeconfig(cfg: Kubeconfig) -> RemoteStore:
+    """Kubeconfig → credentialed RemoteStore (rest.RESTClientFor parity)."""
+    return RemoteStore(
+        cfg.server,
+        token=cfg.token or None,
+        ssl_context=build_ssl_context(cfg),
+        user_agent=cfg.user_agent,
     )
 
 
@@ -272,8 +440,7 @@ def clientset_from_kubeconfig(path_or_cfg) -> Clientset:
         if isinstance(path_or_cfg, Kubeconfig)
         else load_kubeconfig(path_or_cfg)
     )
-    store = RemoteStore(cfg.server)
     return Clientset.new_for_config(
-        store,
+        store_from_kubeconfig(cfg),
         RESTConfig(qps=cfg.qps, burst=cfg.burst, user_agent=cfg.user_agent),
     )

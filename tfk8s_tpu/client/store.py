@@ -54,6 +54,15 @@ class Gone(StoreError):
     the client must relist (HTTP 410 semantics)."""
 
 
+class Unauthorized(StoreError):
+    """No/invalid credentials against a secured apiserver (HTTP 401)."""
+
+
+class Forbidden(StoreError):
+    """Authenticated but not permitted — e.g. a read-only credential
+    attempting a write (HTTP 403)."""
+
+
 class EventType(str, enum.Enum):
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"

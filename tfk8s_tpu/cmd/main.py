@@ -61,12 +61,24 @@ def _build_parser() -> argparse.ArgumentParser:
     p_tr.add_argument("--entrypoint", required=True)
     p_tr.add_argument("--env", default="{}")
 
-    p_api = sub.add_parser("apiserver", help="serve the cluster store over HTTP")
+    p_api = sub.add_parser("apiserver", help="serve the cluster store over HTTP(S)")
     p_api.add_argument("--host", default="127.0.0.1")
     p_api.add_argument("--port", type=int, default=8443)
     p_api.add_argument("--write-kubeconfig", default="", dest="write_kubeconfig",
                        help="write a kubeconfig JSON for the bound address "
-                       "(use with --port 0 to discover the ephemeral port)")
+                       "(use with --port 0 to discover the ephemeral port); "
+                       "with --self-signed/--token-file it embeds the CA "
+                       "and first token")
+    p_api.add_argument("--tls-cert", default="", help="server certificate (PEM)")
+    p_api.add_argument("--tls-key", default="", help="server private key (PEM)")
+    p_api.add_argument("--client-ca", default="",
+                       help="CA bundle for verifying client certs (mTLS)")
+    p_api.add_argument("--self-signed", default="", metavar="DIR",
+                       help="mint a CA + server cert into DIR and serve TLS "
+                       "(dev/test; overrides --tls-cert/--tls-key)")
+    p_api.add_argument("--token-file", default="",
+                       help="static token file 'token,user[,readonly]' per "
+                       "line; enables authentication (anonymous -> 401)")
 
     p_kl = sub.add_parser("kubelet", help="run the pod executor against a remote apiserver")
     p_kl.add_argument("--kubeconfig", required=True)
@@ -247,13 +259,50 @@ def _cmd_train(args: argparse.Namespace) -> int:
 
 
 def _cmd_apiserver(args: argparse.Namespace) -> int:
-    from tfk8s_tpu.client.apiserver import APIServer
+    from tfk8s_tpu.client.apiserver import APIServer, AuthConfig, TLSServerConfig
     from tfk8s_tpu.client.store import ClusterStore
 
-    server = APIServer(ClusterStore(), host=args.host, port=args.port)
+    tls = None
+    ca_pem = ""
+    if args.self_signed:
+        # dev PKI: mint CA + server cert (SANs cover the bind host) so a
+        # secured cluster comes up with one flag — kubeadm-init parity
+        from tfk8s_tpu.client.tlsutil import generate_ca, issue_cert
+
+        ca = generate_ca()
+        sans = [args.host] if args.host not in ("127.0.0.1", "localhost") else []
+        sans += ["127.0.0.1", "localhost"]
+        server_pair = issue_cert(ca, "tfk8s-apiserver", sans=sans)
+        ca_cert_path, _ = ca.write(args.self_signed, "ca")
+        cert_path, key_path = server_pair.write(args.self_signed, "apiserver")
+        tls = TLSServerConfig(cert_path, key_path, client_ca_file=ca_cert_path)
+        ca_pem = ca.cert_pem.decode()
+    elif args.tls_cert or args.tls_key or args.client_ca:
+        # half a TLS config must be a startup error, never a silent
+        # downgrade to plaintext (tokens would go over the wire in clear)
+        if not (args.tls_cert and args.tls_key):
+            log.error("--tls-cert and --tls-key must be given together "
+                      "(got cert=%r key=%r)", args.tls_cert, args.tls_key)
+            return 2
+        tls = TLSServerConfig(
+            args.tls_cert, args.tls_key, client_ca_file=args.client_ca or None
+        )
+        if args.client_ca:
+            with open(args.client_ca) as f:
+                ca_pem = f.read()
+    auth = AuthConfig.from_token_file(args.token_file) if args.token_file else None
+
+    server = APIServer(
+        ClusterStore(), host=args.host, port=args.port, tls=tls, auth=auth
+    )
     if args.write_kubeconfig:
+        kc: dict = {"server": server.url}
+        if ca_pem:
+            kc["certificate_authority_data"] = ca_pem
+        if auth and auth.tokens:
+            kc["token"] = next(iter(auth.tokens))
         with open(args.write_kubeconfig, "w") as f:
-            json.dump({"server": server.url}, f)
+            json.dump(kc, f)
     log.info("apiserver listening on %s", server.url)
     try:
         server.serve_forever()

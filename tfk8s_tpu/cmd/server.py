@@ -39,12 +39,14 @@ class Server:
         elif opts.kubeconfig:
             # remote backend: kubeconfig → RemoteStore, the reference's
             # BuildConfigFromFlags → NewForConfig path
-            # (k8s-operator.md:92-102). The kubeconfig's client limits
-            # take precedence — they describe the server being talked to.
-            from tfk8s_tpu.client.remote import RemoteStore, load_kubeconfig
+            # (k8s-operator.md:92-102) — credentials (CA pin, bearer
+            # token, client cert) ride along like rest.Config. The
+            # kubeconfig's client limits take precedence — they describe
+            # the server being talked to.
+            from tfk8s_tpu.client.remote import load_kubeconfig, store_from_kubeconfig
 
             cfg = load_kubeconfig(opts.kubeconfig)
-            self.store = RemoteStore(cfg.server)
+            self.store = store_from_kubeconfig(cfg)
             qps, burst = cfg.qps, cfg.burst
         else:
             self.store = ClusterStore()

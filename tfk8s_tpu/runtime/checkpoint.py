@@ -9,11 +9,23 @@ the restarted processes restore the last step instead of step 0.
 
 Orbax is the engine; this wraps it with a small, dependency-tolerant
 surface (save-every-N, latest-step discovery, sharding-aware restore).
+
+Paths may be scheme'd URIs (SURVEY.md §5 plans "orbax-style async
+checkpoint to GCS" — the GKE deployment has nowhere durable to write
+otherwise): ``gs://bucket/path`` and ``file:///...`` pass through to
+orbax/tensorstore UNTOUCHED — no ``abspath``/``makedirs`` mangling (the
+r3 gap: ``os.path.abspath("gs://b/p")`` destroyed the URI before orbax
+ever saw it). For hermetic tests and air-gapped dev, setting
+``TFK8S_GCS_FAKE_ROOT=/some/dir`` maps ``gs://bucket/path`` →
+``<root>/bucket/path`` — an explicit local fake of the object store, so
+the gang-resume contract is testable with gs://-shaped specs and the
+exact same URIs work unmapped against real GCS.
 """
 
 from __future__ import annotations
 
 import os
+import re
 from typing import Any, Optional
 
 import jax
@@ -29,16 +41,36 @@ try:  # orbax is baked into the image; tolerate its absence anyway
 except Exception:  # noqa: BLE001
     _HAVE_ORBAX = False
 
+# RFC 3986 scheme — distinguishes URIs (gs://, file://, s3://...) from
+# plain paths, which keep the historical abspath normalization.
+_URI_RE = re.compile(r"^[a-z][a-z0-9+.\-]*://")
+
+
+def resolve_directory(directory: str) -> str:
+    """Normalize a checkpoint location. Plain paths → absolute; URIs pass
+    through untouched, except ``gs://`` when ``TFK8S_GCS_FAKE_ROOT`` maps
+    it onto the local fake object store (module docstring)."""
+    if not _URI_RE.match(directory):
+        return os.path.abspath(directory)
+    if directory.startswith("gs://"):
+        fake_root = os.environ.get("TFK8S_GCS_FAKE_ROOT", "")
+        if fake_root:
+            return os.path.join(os.path.abspath(fake_root), directory[len("gs://"):])
+    return directory
+
 
 class Checkpointer:
     """Save/restore a pytree train state under ``directory/step_N``."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
-        self.directory = os.path.abspath(directory)
+        self.directory = resolve_directory(directory) if directory else directory
         self.max_to_keep = max_to_keep
         self._mgr = None
         if _HAVE_ORBAX and directory:
-            os.makedirs(self.directory, exist_ok=True)
+            if not _URI_RE.match(self.directory):
+                os.makedirs(self.directory, exist_ok=True)
+            # URIs: orbax (CheckpointManagerOptions.create) + tensorstore
+            # own creation semantics on the remote store.
             self._mgr = ocp.CheckpointManager(
                 self.directory,
                 options=ocp.CheckpointManagerOptions(
@@ -57,6 +89,20 @@ class Checkpointer:
         if wait:
             self._mgr.wait_until_finished()
         log.info("saved checkpoint step=%d -> %s", step, self.directory)
+
+    def saving_in_progress(self) -> bool:
+        """True while an async save is still draining on orbax's background
+        thread — ``save(wait=False)`` returns immediately and training
+        overlaps the persistence; callers needing durability barrier on
+        :meth:`wait_until_finished`."""
+        if not self.enabled:
+            return False
+        fn = getattr(self._mgr, "is_saving_in_progress", None)
+        return bool(fn()) if fn is not None else False
+
+    def wait_until_finished(self) -> None:
+        if self.enabled:
+            self._mgr.wait_until_finished()
 
     def all_steps(self) -> list:
         """Every retained checkpoint step, ascending (cadence assertions

@@ -49,9 +49,11 @@ NODE_LEASE_PREFIX = "node-"
 # the pod entrypoints' process (and GIL), so long JAX traces can stall
 # renewal — the default staleness window (2x duration = 40s, the k8s
 # node-lease timeout) must comfortably exceed any single trace. The
-# node-failure test shrinks both to keep the suite fast.
-NODE_LEASE_DURATION_S = float(os.environ.get("TFK8S_NODE_LEASE_DURATION_S", "20.0"))
-NODE_LEASE_RENEW_S = float(os.environ.get("TFK8S_NODE_LEASE_RENEW_S", "4.0"))
+# node-failure test shrinks both to keep the suite fast. Env vars are
+# read at LocalKubelet CONSTRUCTION, not import (r3 advisor finding:
+# settings applied after first import were silently ignored).
+NODE_LEASE_DURATION_DEFAULT_S = 20.0
+NODE_LEASE_RENEW_DEFAULT_S = 4.0
 
 
 class _PodLogRouter(logging.Handler):
@@ -105,13 +107,25 @@ class LocalKubelet:
         self,
         clientset: Clientset,
         name: str = "local-kubelet",
-        lease_duration_s: float = NODE_LEASE_DURATION_S,
-        lease_renew_s: float = NODE_LEASE_RENEW_S,
+        lease_duration_s: Optional[float] = None,
+        lease_renew_s: Optional[float] = None,
     ):
         self.cs = clientset
         self.name = name
-        self.lease_duration_s = lease_duration_s
-        self.lease_renew_s = lease_renew_s
+        self.lease_duration_s = (
+            float(os.environ.get(
+                "TFK8S_NODE_LEASE_DURATION_S", NODE_LEASE_DURATION_DEFAULT_S
+            ))
+            if lease_duration_s is None
+            else lease_duration_s
+        )
+        self.lease_renew_s = (
+            float(os.environ.get(
+                "TFK8S_NODE_LEASE_RENEW_S", NODE_LEASE_RENEW_DEFAULT_S
+            ))
+            if lease_renew_s is None
+            else lease_renew_s
+        )
         self.informer = SharedIndexInformer(clientset.pods(namespace=None), name="kubelet-pod")
         self.informer.add_event_handler(
             ResourceEventHandler(
