@@ -30,21 +30,27 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _median_window(timed_once, windows: int = 3) -> float:
-    """Median wall-clock seconds of ``windows`` calls to ``timed_once``
+_WINDOWS = 3
+
+
+def _median_window(timed_once, windows: int = _WINDOWS):
+    """(median, all_window_seconds) of ``windows`` calls to ``timed_once``
     (a no-arg callable that runs AND host-syncs one timed region).
     Single windows swing ~±15% on this device (thermal / tunnel
-    contention); the median is repeatable to ±0.3%."""
+    contention); the median is repeatable to ±0.3%. The raw windows ride
+    the output's ``noise`` block so every BENCH_r*.json self-describes
+    its spread (VERDICT r3 next #9)."""
     times = []
     for _ in range(windows):
         t0 = time.perf_counter()
         timed_once()
         times.append(time.perf_counter() - t0)
-    return sorted(times)[len(times) // 2]
+    return sorted(times)[len(times) // 2], times
 
 
-def _time_task(task, mesh, steps: int, n_stage: int = 4) -> float:
-    """Seconds per training step, measured over ``steps`` scanned steps."""
+def _time_task(task, mesh, steps: int, n_stage: int = 4):
+    """(seconds-per-step, per-window seconds-per-step list), measured over
+    ``steps`` scanned steps."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -88,7 +94,8 @@ def _time_task(task, mesh, steps: int, n_stage: int = 4) -> float:
         _state, losses = run(state, stacked, steps)
         float(np.asarray(losses)[-1])
 
-    return _median_window(timed_once) / steps
+    med, windows = _median_window(timed_once)
+    return med / steps, [w / steps for w in windows]
 
 
 def _fit_step_time(task, mesh, steps: int) -> float:
@@ -175,9 +182,66 @@ def _flash_speedup(seq: int = 2048, iters: int = 8, blocks=None):
             out = run(q)
             float(np.asarray(out[0, 0, 0, 0]))
 
-        return _median_window(timed_once) / iters * 1000
+        return _median_window(timed_once)[0] / iters * 1000
 
     return time_one(flash_attention), time_one(dot_product_attention)
+
+
+def _tunnel_probes(task, mesh):
+    """MEASURED per-step tunnel costs, so the fit-vs-scanned gap is
+    bounded in the artifact instead of asserted in prose (VERDICT r3
+    next #7). Three numbers:
+
+    - sync round trip: dispatch + 4-byte fetch (what ANY per-scalar
+      ``float()`` costs mid-loop — ~50-100 ms on the remote rig, which
+      is why fit batches its metric fetches and drains its inflight
+      window with one fetch per half-window);
+    - dispatch enqueue: the async per-call host cost the fit loop
+      actually pays per step (~0.1 ms — dispatches pipeline);
+    - h2d per batch: staging one host batch (enqueue + transfer drain).
+
+    Returns (sync_rtt_s, enqueue_s, h2d_s_per_batch, batch_bytes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    trainer = Trainer(task, TrainConfig(steps=1), mesh)
+    host_batch = task.make_batch(np.random.default_rng(1), task.batch_size)
+    shardings = trainer.batch_shardings
+
+    inc = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0)
+    float(inc(x))  # compile
+
+    def rtt_once():
+        float(inc(x))  # dispatch + 4-byte fetch: one full round trip
+
+    rtt, _ = _median_window(rtt_once, windows=9)
+
+    n_enq = 64
+    y = jnp.float32(0)
+    t0 = time.perf_counter()
+    for _ in range(n_enq):
+        y = inc(y)
+    enqueue = (time.perf_counter() - t0) / n_enq
+    float(y)  # drain the chain
+
+    batch_bytes = int(
+        sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(host_batch))
+    )
+
+    def h2d_once():
+        dev = jax.device_put(host_batch, shardings)
+        leaf = jax.tree_util.tree_leaves(dev)[0]
+        # reduce ON DEVICE, fetch the scalar — the honest completion
+        # barrier without pulling the batch back through the tunnel
+        float(jnp.sum(leaf.astype(jnp.float32)))
+
+    h2d_once()  # warm the reduce's compile
+    h2d_total, _ = _median_window(h2d_once, windows=5)
+    return rtt, enqueue, max(h2d_total - rtt, 0.0), batch_bytes
 
 
 _PROBE_CODE = """
@@ -227,6 +291,13 @@ def _probe_backend(timeout_s: float) -> None:
 
 
 def main() -> None:
+    if "--roofline" in sys.argv:
+        # the committed platform-envelope harness (tools/roofline.py):
+        # matmul TF/s, streaming GB/s, Pallas DMA, ResNet decomposition
+        from tools import roofline
+
+        roofline.main()
+        return
     # CPU runs can't hang on a dead tunnel — skip the (double-init) probe
     if os.environ.get("BENCH_PLATFORM") != "cpu":
         _probe_backend(float(os.environ.get("BENCH_PROBE_TIMEOUT", "300")))
@@ -261,7 +332,7 @@ def main() -> None:
             batch_size=int(os.environ.get("BENCH_BATCH", "256")),
         )
         steps = 30
-    sec_per_step = _time_task(rn_task, mesh, steps)
+    sec_per_step, rn_windows = _time_task(rn_task, mesh, steps)
     value = rn_task.batch_size / sec_per_step / n_chips
 
     # -- secondary: BERT-base MLM step-time (BASELINE.md row 2) -------------
@@ -279,7 +350,7 @@ def main() -> None:
             batch_size=int(os.environ.get("BENCH_BERT_BATCH", "64")),
         )
         bsteps = 50
-    bert_sec = _time_task(bert_task, mesh, bsteps)
+    bert_sec, bert_windows = _time_task(bert_task, mesh, bsteps)
 
     # -- the PRODUCT loop: Trainer.fit with its prefetch pipeline must
     # agree with the scanned number (VERDICT r2 next #3). Measured on
@@ -289,6 +360,9 @@ def main() -> None:
     # PERF_RESNET.md) stays off the critical path. The CPU-mesh test
     # tests/test_train_runtime.py covers the ResNet-shaped agreement.
     fit_sec = _fit_step_time(bert_task, mesh, 12 if small else 30)
+
+    # measured per-step tunnel costs bounding the fit-vs-scanned gap
+    rtt_s, enq_s, h2d_s, batch_bytes = _tunnel_probes(bert_task, mesh)
 
     # -- flash-attention win at long sequence (VERDICT r2 #4): autotuned
     # blocks, plus a REAL long-context model row (BERT seq-2048, flash)
@@ -313,16 +387,21 @@ def main() -> None:
                 mesh, cfg=bert2k_cfg, seq_len=2048,
                 batch_size=int(os.environ.get("BENCH_BERT2K_BATCH", "8")),
             )
-            bert2k_sec = _time_task(bert2k_task, mesh, 20)
+            bert2k_sec, _bert2k_windows = _time_task(bert2k_task, mesh, 20)
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     baseline_note = {}
+    # the baseline's documented measurement band (BENCH_BASELINE.json
+    # "band", falling back to the round-2 recorded inter-run spread):
+    # a vs_baseline inside it is measurement noise, outside it is signal
+    band = [0.92, 1.08]
     if os.path.exists(baseline_path):
         try:
             prior = json.load(open(baseline_path))
             if prior.get("value"):
                 vs = value / float(prior["value"])
+                band = list(prior.get("band", band))
                 # an apples-to-apples ratio needs matching config; flag a
                 # mismatch rather than passing config drift off as a win
                 pb = prior.get("extra", {}).get("resnet_batch_size")
@@ -333,6 +412,16 @@ def main() -> None:
                     }
         except (ValueError, KeyError):
             pass
+
+    # -- committed roofline block (tools/roofline.py; VERDICT r3 next #2):
+    # the platform envelope the memory-bound headline claim is judged
+    # against, re-measured every bench run so drift is visible -----------
+    roofline_block = None
+    if os.environ.get("BENCH_ROOFLINE", "1") == "1":
+        from tools import roofline
+
+        roofline_block = roofline.run_all(small=small)
+        roofline_block["resnet_step_ms"] = round(sec_per_step * 1000, 1)
 
     # Absolute efficiency (VERDICT r2 next #1): MFU from model FLOPs and
     # the chip's bf16 spec — drift-proof, unlike the ±5% vs_baseline
@@ -366,10 +455,35 @@ def main() -> None:
                     "bert_base_mlm_step_time_ms": round(bert_sec * 1000, 3),
                     "bert_fit_step_time_ms": round(fit_sec * 1000, 3),
                     "bert_fit_vs_scanned": round(fit_sec / bert_sec, 3),
+                    # the gap, and the measured tunnel costs that bound it
+                    # (per step the product loop pays one async dispatch
+                    # enqueue + one batch H2D the scanned bench does not;
+                    # the sync round trip is what any mid-loop scalar
+                    # fetch would cost — why fit batches its fetches)
+                    "fit_gap_ms_per_step": round((fit_sec - bert_sec) * 1000, 3),
+                    "tunnel_sync_roundtrip_ms": round(rtt_s * 1000, 3),
+                    "tunnel_dispatch_enqueue_ms": round(enq_s * 1000, 3),
+                    "tunnel_h2d_ms_per_batch": round(h2d_s * 1000, 3),
+                    "tunnel_h2d_mbps": round(batch_bytes / max(h2d_s, 1e-9) / 1e6, 1),
                     "bert_batch_size": bert_task.batch_size,
                     "bert_seq_len": bert_seq,
                     "resnet_batch_size": rn_task.batch_size,
                     "n_chips": n_chips,
+                    # self-described noise floor (VERDICT r3 next #9)
+                    "noise": {
+                        "windows_per_metric": _WINDOWS,
+                        "resnet_step_windows_ms": [
+                            round(w * 1000, 2) for w in rn_windows
+                        ],
+                        "bert_step_windows_ms": [
+                            round(w * 1000, 2) for w in bert_windows
+                        ],
+                        "baseline_band": band,
+                        "vs_baseline_outside_band": not (
+                            band[0] <= vs <= band[1]
+                        ),
+                    },
+                    **({"roofline": roofline_block} if roofline_block else {}),
                     **(
                         {
                             "flash_attn_ms_seq2048": round(flash_ms, 3),

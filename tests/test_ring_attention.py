@@ -49,6 +49,100 @@ def test_under_jit():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_full_attention(causal):
+    """The hand-written ring VJP (flash-2 recomputation + dk/dv rotating
+    home) must agree with autodiff through the full-attention reference
+    — for q, k, AND v."""
+    mesh = make_mesh(sequence=4)
+    q, k, v = _qkv()
+    ring = make_ring_attn_fn(mesh)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, causal=causal).astype(jnp.float32) ** 2
+        )
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_gradients_with_inner_chunking():
+    """Force the blockwise inner scan (block_k < local block) and check
+    grads still match — the chunk-stacking order in the backward is the
+    easy thing to get wrong."""
+    mesh = make_mesh(sequence=2)
+    q, k, v = _qkv(b=1, l=256, h=2, d=8)
+    ring = make_ring_attn_fn(mesh, block_k=64)  # local lk=128 -> 2 chunks
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(
+            dot_product_attention(q, k, v, causal=True).astype(jnp.float32) ** 2
+        )
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=2e-3, err_msg=f"d{name}"
+        )
+
+
+def _np_row_chunked_reference(q, k, v, causal, rows=1024):
+    """Float64 numpy reference with row-chunked softmax — O(rows·L)
+    memory, so 8k×8k never materializes (independent of the jax paths)."""
+    b, l, h, d = q.shape
+    out = np.zeros((b, l, h, d), np.float64)
+    qn = np.asarray(q, np.float64)
+    kn = np.asarray(k, np.float64)
+    vn = np.asarray(v, np.float64)
+    for bi in range(b):
+        for hi in range(h):
+            for r0 in range(0, l, rows):
+                r1 = min(r0 + rows, l)
+                s = qn[bi, r0:r1, hi] @ kn[bi, :, hi].T  # [rows, L]
+                if causal:
+                    mask = np.arange(r0, r1)[:, None] >= np.arange(l)[None, :]
+                    s = np.where(mask, s, -1e30)
+                s -= s.max(axis=-1, keepdims=True)
+                p = np.exp(s)
+                p /= p.sum(axis=-1, keepdims=True)
+                out[bi, r0:r1, hi] = p @ vn[bi, :, hi]
+    return out
+
+
+@pytest.mark.slow
+def test_long_sequence_8k_matches_reference():
+    """The SP headline case: seq 8192 over an 8-way ring (1024 tokens per
+    device, inner chunks of 512) matches exact attention — verified
+    against an independent numpy reference since the XLA full-attention
+    path would materialize the 8k x 8k scores this code exists to avoid."""
+    mesh = make_mesh(sequence=8)
+    rng = np.random.default_rng(7)
+    b, l, h, d = 1, 8192, 1, 16
+    mk = lambda: jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    ring = make_ring_attn_fn(mesh)
+    for causal in (False, True):
+        got = np.asarray(ring(q, k, v, causal=causal))
+        want = _np_row_chunked_reference(q, k, v, causal)
+        np.testing.assert_allclose(
+            got, want.astype(np.float32), atol=2e-4,
+            err_msg=f"causal={causal}",
+        )
+
+
 def test_padding_mask_rejected():
     mesh = make_mesh(sequence=4)
     q, k, v = _qkv()

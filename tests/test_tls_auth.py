@@ -283,12 +283,59 @@ class TestKubeconfigFormats:
         build_ssl_context(cfg)
         assert len(remote_mod._staged_dirs) == before + 1
 
+    def test_bad_context_reference_rejected(self, tmp_path):
+        path = tmp_path / "bad-ctx.yaml"
+        path.write_text(
+            "current-context: prod\n"
+            "clusters:\n"
+            "- name: staging\n"
+            "  cluster: {server: https://127.0.0.1:1}\n"
+            "contexts:\n"
+            "- name: prod\n"
+            "  context: {cluster: prod-cluster, user: op}\n"
+            "users:\n"
+            "- name: op\n"
+            "  user: {token: t}\n"
+        )
+        with pytest.raises(ValueError, match='unknown cluster "prod-cluster"'):
+            load_kubeconfig(str(path))
+
     def test_token_file_parsing(self, tmp_path):
         p = tmp_path / "tokens.csv"
         p.write_text(f"# static tokens\n{TOKEN},operator\n{RO_TOKEN},viewer,readonly\n")
         auth = AuthConfig.from_token_file(str(p))
         assert auth.tokens[TOKEN] == User("operator")
         assert auth.tokens[RO_TOKEN].readonly
+
+
+class TestCLISafetyRails:
+    def test_token_file_without_tls_refused(self, tmp_path):
+        # bearer tokens over plaintext HTTP would be sniffable — hard error
+        from tfk8s_tpu.cmd.main import main
+
+        tf = tmp_path / "tokens.csv"
+        tf.write_text(f"{TOKEN},admin\n")
+        assert main(["apiserver", "--port", "0", "--token-file", str(tf)]) == 2
+
+    def test_half_tls_config_refused(self, tmp_path, pki):
+        from tfk8s_tpu.cmd.main import main
+
+        assert main(
+            ["apiserver", "--port", "0", "--tls-cert", pki["cert_path"]]
+        ) == 2
+
+    def test_write_kubeconfig_skips_readonly_tokens(self, tmp_path):
+        from tfk8s_tpu.cmd.main import main
+
+        tf = tmp_path / "tokens.csv"
+        tf.write_text(f"{RO_TOKEN},viewer,readonly\n")
+        # only readonly credentials -> nothing usable to embed -> error
+        assert main([
+            "apiserver", "--port", "0",
+            "--self-signed", str(tmp_path / "pki"),
+            "--token-file", str(tf),
+            "--write-kubeconfig", str(tmp_path / "kc.json"),
+        ]) == 2
 
 
 class TestSecuredReconcileE2E:

@@ -308,15 +308,31 @@ def _b64_or_pem(value: str) -> str:
 
 def _from_k8s_kubeconfig(data: Dict[str, Any]) -> Kubeconfig:
     """Parse the real kubeconfig shape (clusters/users/contexts +
-    current-context), honoring ``*-data`` inline credentials."""
+    current-context), honoring ``*-data`` inline credentials. A context
+    naming a nonexistent cluster/user is an ERROR (kubectl parity) —
+    silently picking another cluster would connect somewhere else with
+    the wrong credentials."""
     by_name = lambda items, key: {i["name"]: i[key] for i in items or []}  # noqa: E731
     clusters = by_name(data.get("clusters"), "cluster")
     users = by_name(data.get("users"), "user")
     contexts = by_name(data.get("contexts"), "context")
+    if not clusters:
+        raise ValueError("kubeconfig has no clusters")
     ctx_name = data.get("current-context") or next(iter(contexts), "")
     ctx = contexts.get(ctx_name, {})
-    cluster = clusters.get(ctx.get("cluster", ""), next(iter(clusters.values()), {}))
-    user = users.get(ctx.get("user", ""), next(iter(users.values()), {}))
+
+    def pick(pool: Dict[str, Any], ref: str, what: str) -> Dict[str, Any]:
+        if ref:
+            if ref not in pool:
+                raise ValueError(
+                    f'kubeconfig context "{ctx_name}" references unknown '
+                    f'{what} "{ref}"'
+                )
+            return pool[ref]
+        return next(iter(pool.values()), {})
+
+    cluster = pick(clusters, ctx.get("cluster", ""), "cluster")
+    user = pick(users, ctx.get("user", ""), "user")
     return Kubeconfig(
         server=cluster["server"],
         certificate_authority=cluster.get("certificate-authority", ""),

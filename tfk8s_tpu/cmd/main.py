@@ -291,6 +291,13 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
             with open(args.client_ca) as f:
                 ca_pem = f.read()
     auth = AuthConfig.from_token_file(args.token_file) if args.token_file else None
+    if auth is not None and tls is None:
+        # same rule as the half-TLS case: bearer tokens over plaintext
+        # HTTP are sniffable — a silent downgrade must be a startup error
+        log.error("--token-file requires TLS (--tls-cert/--tls-key or "
+                  "--self-signed): refusing to accept bearer tokens over "
+                  "plaintext HTTP")
+        return 2
 
     server = APIServer(
         ClusterStore(), host=args.host, port=args.port, tls=tls, auth=auth
@@ -300,7 +307,17 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
         if ca_pem:
             kc["certificate_authority_data"] = ca_pem
         if auth and auth.tokens:
-            kc["token"] = next(iter(auth.tokens))
+            # the embedded credential must be able to WRITE (a kubelet or
+            # operator bootstrapped from this kubeconfig creates pods);
+            # a readonly first entry would fail far from its cause
+            rw = next(
+                (t for t, u in auth.tokens.items() if not u.readonly), None
+            )
+            if rw is None:
+                log.error("--write-kubeconfig: token file has only "
+                          "readonly credentials; nothing usable to embed")
+                return 2
+            kc["token"] = rw
         with open(args.write_kubeconfig, "w") as f:
             json.dump(kc, f)
     log.info("apiserver listening on %s", server.url)

@@ -26,6 +26,7 @@ philosophy as models/mlp.py.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -76,11 +77,39 @@ def _groups(channels: int) -> int:
     return max(g, 1)
 
 
+class _Identity(nn.Module):
+    """Stand-in for an ablated norm (measurement probes only)."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return x
+
+
+# Measurement-probe switch (tools/roofline.py): True = normal GroupNorm,
+# False = every norm is identity, isolating the norm chain's cost in the
+# step-time decomposition (PERF_RESNET.md §4). Never a training config.
+_NORM_ENABLED = True
+
+
+@contextlib.contextmanager
+def ablate_norm():
+    """Scope in which every ResNet norm is identity. Model construction
+    AND jit tracing must happen inside the scope (flax traces lazily)."""
+    global _NORM_ENABLED
+    _NORM_ENABLED = False
+    try:
+        yield
+    finally:
+        _NORM_ENABLED = True
+
+
 def _norm(channels: int, name: Optional[str] = None, scale_init=nn.initializers.ones):
     # dtype=bf16 halves the HBM traffic of every norm/relu chain (+28%
     # measured step throughput at batch 256); numerically safe because
     # flax computes the mean/variance statistics in float32 internally
     # regardless of dtype — only the normalized OUTPUT is bf16.
+    if not _NORM_ENABLED:
+        return _Identity(name=name)
     return nn.GroupNorm(
         num_groups=_groups(channels), dtype=jnp.bfloat16, param_dtype=jnp.float32,
         scale_init=scale_init, name=name,

@@ -1,4 +1,5 @@
-"""Ring attention: exact attention over a sequence-sharded mesh axis.
+"""Ring attention: exact attention over a sequence-sharded mesh axis,
+with flash-attention memory behavior end to end.
 
 The long-context path the reference lacks entirely (SURVEY.md §2 'SP /
 CP / ring-attention' row, §5 'Long-context'): the sequence dimension is
@@ -6,12 +7,27 @@ sharded over the ``sequence`` mesh axis; each device holds one Q/K/V
 block and K/V blocks rotate around the ring via ``lax.ppermute`` (one
 ICI hop per step — neighbor exchange, the cheapest collective on a TPU
 torus), while queries stay put. Softmax is accumulated online
-(flash-attention style running max / denominator), so the result is
-*exact* full attention with O(L/S) memory per device and compute/comm
-overlap XLA can pipeline.
+(flash-attention running max / denominator), so the result is *exact*
+full attention.
 
-Blockwise compute is a ``lax.fori_loop`` (static trip count = ring size)
-— compiler-friendly control flow, one trace (SURVEY.md 'XLA semantics').
+Memory is the point of SP, so this is a ``jax.custom_vjp`` with the
+FlashAttention-2 recomputation scheme rather than autodiff through the
+loop (which would checkpoint per-ring-step carries and surrender the
+O(L·d) property exactly at the long sequences SP exists for):
+
+- forward: each held block is consumed in ``block_k``-sized chunks
+  (``lax.scan``) so live score tensors are [lq_local, block_k], never
+  [lq_local, lk_local]; residuals saved are only (q, k, v, out, lse) —
+  O(L·d) per device, matching ``ops/flash_attention.py``'s kernels.
+- backward: a second ring pass recomputes probabilities blockwise from
+  (q, k, lse), accumulating dq locally while (k, v, dk, dv) rotate
+  TOGETHER — after a full loop each block's dk/dv accumulator has
+  collected every query shard's contribution and arrived back at its
+  home device (the standard ring-attention backward).
+
+Blockwise compute is ``lax.fori_loop``/``lax.scan`` with static trip
+counts — compiler-friendly control flow, one trace (SURVEY.md 'XLA
+semantics').
 
 Usage: ``make_ring_attn_fn(mesh)`` returns an ``attn_fn`` drop-in for
 ``models/transformer.MultiHeadAttention`` — the blocks route through it
@@ -20,7 +36,6 @@ whenever the job's mesh has a nontrivial sequence axis.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -38,72 +53,201 @@ from tfk8s_tpu.parallel.mesh import (
 
 _NEG = -1e30
 
+# Inner chunk-size candidates for the per-block online-softmax scan
+# (mirrors ops/flash_attention.py's k-block candidates); a local K/V
+# block shorter than the smallest candidate is consumed whole.
+_BLOCK_K_CANDIDATES = (512, 256, 128)
 
-def _ring_attention_local(
-    q: jax.Array,  # [b, lq, h, d] local block, pre-scaled
-    k: jax.Array,  # [b, lk, h, d] local block
-    v: jax.Array,  # [b, lk, h, d]
-    axis_name: str,
-    causal: bool,
-) -> jax.Array:
-    """Per-device body under shard_map: rotate K/V around the ring,
-    accumulating the online softmax."""
+
+def _pick_bk(lk: int, block_k: Optional[int]) -> int:
+    if block_k is not None:
+        bk = min(block_k, lk)
+        if lk % bk:
+            # a non-dividing chunk would silently drop the trailing
+            # lk % bk key columns from the online softmax
+            raise ValueError(
+                f"ring attention block_k={block_k} does not divide the "
+                f"local K/V block length {lk}"
+            )
+        return bk
+    return next((c for c in _BLOCK_K_CANDIDATES if lk % c == 0), lk)
+
+
+def _online_block(qf, q_pos, kt, vt, src, bk, causal, m, l, o):
+    """Fold one ring-held K/V block into the online softmax, ``bk``
+    columns at a time. Carries: running max ``m`` [b,h,lq], denominator
+    ``l`` [b,h,lq], unnormalized output ``o`` [b,lq,h,d]."""
+    lk = kt.shape[1]
+    nb = lk // bk
+
+    def chunk(carry, cb):
+        m, l, o = carry
+        ks = lax.dynamic_slice_in_dim(kt, cb * bk, bk, 1).astype(jnp.float32)
+        vs = lax.dynamic_slice_in_dim(vt, cb * bk, bk, 1).astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks)
+        if causal:
+            k_pos = src * lk + cb * bk + jnp.arange(bk)
+            cm = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(cm[None, None], s, _NEG)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vs
+        )
+        return (m_new, l_new, o_new), None
+
+    (m, l, o), _ = lax.scan(chunk, (m, l, o), jnp.arange(nb))
+    return m, l, o
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, block_k):
     ring = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    bk = _pick_bk(lk, block_k)
 
     qf = q.astype(jnp.float32)
     q_pos = me * lq + jnp.arange(lq)  # global query positions
 
-    # carries: running max m [b,h,lq], denom l [b,h,lq], out o [b,lq,h,d]
     m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
     l0 = jnp.zeros((b, h, lq), jnp.float32)
     o0 = jnp.zeros((b, lq, h, d), jnp.float32)
-
     perm = [(i, (i + 1) % ring) for i in range(ring)]
-
-    def process_block(t, m, l, o, kt, vt):
-        # block now held originated on shard (me - t) mod ring
-        src = (me - t) % ring
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, kt.astype(jnp.float32)
-        )
-        if causal:
-            k_pos = src * lk + jnp.arange(lk)
-            cm = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(cm[None, None], scores, _NEG)
-        blk_max = jnp.max(scores, axis=-1)
-        m_new = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vt.astype(jnp.float32)
-        )
-        return m_new, l_new, o_new
 
     def body(t, carry):
         m, l, o, kt, vt = carry
-        m, l, o = process_block(t, m, l, o, kt, vt)
-        k_next = lax.ppermute(kt, axis_name, perm)
-        v_next = lax.ppermute(vt, axis_name, perm)
-        return m, l, o, k_next, v_next
+        # block now held originated on shard (me - t) mod ring
+        m, l, o = _online_block(
+            qf, q_pos, kt, vt, (me - t) % ring, bk, causal, m, l, o
+        )
+        return (
+            m, l, o,
+            lax.ppermute(kt, axis_name, perm),
+            lax.ppermute(vt, axis_name, perm),
+        )
 
     # ring-1 rotate+process iterations; the final held block needs no
     # outgoing permute (it would be dead traffic on ICI)
     m, l, o, kt, vt = lax.fori_loop(0, ring - 1, body, (m0, l0, o0, k, v))
-    m, l, o = process_block(ring - 1, m, l, o, kt, vt)
-    # fully-masked rows (causal, early ring slots) have l == 0; output 0
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return (o / denom).astype(q.dtype)
+    m, l, o = _online_block(
+        qf, q_pos, kt, vt, (me - (ring - 1)) % ring, bk, causal, m, l, o
+    )
+    # fully-masked rows (causal, early ring slots) have l == 0 per block,
+    # but after the full ring every query row has seen its own position
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)  # [b, h, lq] — the only O(L) residual
+    return out, lse
 
 
-def make_ring_attn_fn(mesh: Mesh, seq_axis: str = AXIS_SEQUENCE):
+def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k):
+    ring = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bk = _pick_bk(lk, block_k)
+
+    qf = q.astype(jnp.float32)
+    do = g.astype(jnp.float32)
+    q_pos = me * lq + jnp.arange(lq)
+    # D = rowsum(dO ∘ O) — the FlashAttention-2 softmax-grad shortcut
+    dvec = jnp.sum(do * out.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def block_grads(kt, vt, src):
+        """dq contribution of the held block, plus the block's own
+        (dk, dv) — each k column's gradient depends only on this device's
+        queries within this ring step, so chunks stack cleanly."""
+        nb = lk // bk
+
+        def chunk(dq_acc, cb):
+            ks = lax.dynamic_slice_in_dim(kt, cb * bk, bk, 1).astype(jnp.float32)
+            vs = lax.dynamic_slice_in_dim(vt, cb * bk, bk, 1).astype(jnp.float32)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks)
+            if causal:
+                k_pos = src * lk + cb * bk + jnp.arange(bk)
+                cm = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(cm[None, None], s, _NEG)
+            p = jnp.exp(s - lse[..., None])  # masked: exp(_NEG - lse) = 0
+            dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, do)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", do, vs)
+            ds = p * (dp - dvec[..., None])
+            dq_new = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+            dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+            return dq_new, (dk_c, dv_c)
+
+        dq_c, (dk_st, dv_st) = lax.scan(
+            chunk, jnp.zeros((b, lq, h, d), jnp.float32), jnp.arange(nb)
+        )
+        # [nb, b, bk, h, d] -> [b, nb*bk, h, d] (chunks are in order)
+        dk_b = jnp.moveaxis(dk_st, 0, 1).reshape(b, lk, h, d)
+        dv_b = jnp.moveaxis(dv_st, 0, 1).reshape(b, lk, h, d)
+        return dq_c, dk_b, dv_b
+
+    def body(t, carry):
+        dq, kt, vt, dk, dv = carry
+        dq_c, dk_b, dv_b = block_grads(kt, vt, (me - t) % ring)
+        # dk/dv ride the SAME rotation as k/v: after the full ring each
+        # block's accumulator has collected every device's contribution
+        # and is back home (ring ppermutes = identity)
+        return (
+            dq + dq_c,
+            lax.ppermute(kt, axis_name, perm),
+            lax.ppermute(vt, axis_name, perm),
+            lax.ppermute(dk + dk_b, axis_name, perm),
+            lax.ppermute(dv + dv_b, axis_name, perm),
+        )
+
+    zeros_kv = jnp.zeros((b, lk, h, d), jnp.float32)
+    dq, kt, vt, dk, dv = lax.fori_loop(
+        0, ring - 1,
+        body,
+        (jnp.zeros((b, lq, h, d), jnp.float32), k, v, zeros_kv, zeros_kv),
+    )
+    # final block: k/v get no outgoing permute (dead ICI traffic, same as
+    # the forward); dk/dv take their ring-th hop home
+    dq_c, dk_b, dv_b = block_grads(kt, vt, (me - (ring - 1)) % ring)
+    dq = dq + dq_c
+    dk = lax.ppermute(dk + dk_b, axis_name, perm)
+    dv = lax.ppermute(dv + dv_b, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _make_local_attn(axis_name: str, causal: bool, block_k: Optional[int]):
+    """The per-device body under shard_map, as a custom_vjp so training
+    keeps the O(L·d) residual footprint (module docstring)."""
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return _ring_fwd_impl(q, k, v, axis_name, causal, block_k)[0]
+
+    def fwd(q, k, v):
+        out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, block_k)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        q, k, v, out, lse = res
+        return _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k)
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def make_ring_attn_fn(
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQUENCE,
+    block_k: Optional[int] = None,
+):
     """Build an ``attn_fn(q, k, v, mask=None, causal=False)`` that runs
     ring attention with batch over data(+fsdp), heads over tensor, and
-    sequence over ``seq_axis``. Requires mask=None (padding masks would
-    need per-block mask rotation — synthetic pretraining data is unpadded)."""
+    sequence over ``seq_axis``. ``block_k`` sets the inner chunk width
+    (None = largest of 512/256/128 dividing the local block). Requires
+    mask=None (padding masks would need per-block mask rotation —
+    synthetic pretraining data is unpadded)."""
     batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
     head_axis = AXIS_TENSOR if AXIS_TENSOR in mesh.axis_names else None
     bspec = batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None)
@@ -115,9 +259,7 @@ def make_ring_attn_fn(mesh: Mesh, seq_axis: str = AXIS_SEQUENCE):
                 "ring attention: padding masks not supported; pass mask=None"
             )
         inner = shard_map(
-            functools.partial(
-                _ring_attention_local, axis_name=seq_axis, causal=causal
-            ),
+            _make_local_attn(seq_axis, causal, block_k),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

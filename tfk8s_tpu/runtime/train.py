@@ -125,6 +125,27 @@ class TrainConfig:
     # the trace keeps per-step annotations. Costs k staged batches of
     # device memory.
     scan_steps: int = 1
+    # Input synthesis topology (the TF_CONFIG-era per-task input division,
+    # k8s-operator.md:6 — each worker owns its own input shard):
+    # - "replicated": every process builds the FULL global batch from one
+    #   sequential rng stream (single-host default; on multi-host it
+    #   replicates all input work and global-batch host memory per host).
+    # - "per_host": the global batch is the ordered concatenation of
+    #   ``input_shards`` independently-seeded shard streams; each process
+    #   synthesizes ONLY the shards covering its addressable rows and the
+    #   global array is assembled with
+    #   ``jax.make_array_from_process_local_data`` — host input work and
+    #   memory scale 1/hosts.
+    # None = auto: "per_host" when jax.process_count() > 1.
+    # The per_host batch content depends only on (seed, step, input_shards)
+    # — NOT on the process topology — so any process count produces the
+    # same global stream (a single process can emulate any shard layout
+    # bit-for-bit; tests/test_distributed.py proves 1-proc == 2-proc).
+    input_mode: Optional[str] = None
+    # number of logical input shards in per_host mode (None = process
+    # count); must divide batch_size (and batch_size/input_shards must be
+    # a multiple of grad_accum_steps)
+    input_shards: Optional[int] = None
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer is not None:
@@ -225,6 +246,10 @@ class Trainer:
         self.config = config
         self.mesh = mesh
         self.optimizer = config.make_optimizer()
+        # set by fit() in per-host input mode: (shard_lo, shard_hi, total)
+        self.input_shard_range: Optional[Tuple[int, int, int]] = None
+        self._per_host_active = False
+        self._stack_fns: Dict[int, Any] = {}  # arity -> jitted metric stack
         self._build()
 
     # -- sharding/jit plumbing ---------------------------------------------
@@ -398,6 +423,145 @@ class Trainer:
 
         return jax.tree_util.tree_map(one, host_batch)
 
+    # -- host-fetch batching -----------------------------------------------
+
+    def _fetch_metrics(self, metrics: Dict[str, Any]) -> Dict[str, float]:
+        """Fetch a metrics dict in ONE host transfer. Per-scalar ``float()``
+        costs a full tunnel round trip EACH (~50-90 ms measured on the
+        remote rig) even for ready values; stacking on device first makes
+        a log boundary cost one fetch instead of len(metrics)."""
+        keys = sorted(metrics)
+        stack = self._stack_fns.get(len(keys))
+        if stack is None:
+            stack = jax.jit(lambda *xs: jnp.stack([x.astype(jnp.float32) for x in xs]))
+            self._stack_fns[len(keys)] = stack
+        vals = np.asarray(stack(*(metrics[k] for k in keys)))
+        return dict(zip(keys, map(float, vals)))
+
+    # -- per-host input plumbing -------------------------------------------
+
+    def _batch_dim(self) -> int:
+        """Index of the sharded batch dim in a PREPARED batch leaf (the
+        microbatch dim under gradient accumulation)."""
+        return 1 if max(self.config.grad_accum_steps, 1) > 1 else 0
+
+    def _input_shard_plan(self) -> Tuple[int, int, int]:
+        """Per-host input decomposition: returns ``(shard_lo, shard_hi,
+        num_shards)`` — the half-open range of input shards THIS process
+        must synthesize, derived from which rows of the sharded batch dim
+        its addressable devices actually hold (``devices_indices_map``),
+        so the row→process mapping is read off the real sharding rather
+        than assumed."""
+        cfg, task = self.config, self.task
+        num_shards = cfg.input_shards or jax.process_count()
+        accum = max(cfg.grad_accum_steps, 1)
+        if task.batch_size % num_shards:
+            raise ValueError(
+                f"input_shards={num_shards} does not divide "
+                f"batch_size={task.batch_size}"
+            )
+        if (task.batch_size // num_shards) % accum:
+            raise ValueError(
+                f"per-shard batch {task.batch_size // num_shards} must be "
+                f"a multiple of grad_accum_steps={accum}"
+            )
+        dim = self._batch_dim()
+        pair = next(
+            (
+                (np.shape(l), s)
+                for l, s in zip(
+                    jax.tree_util.tree_leaves(self._example_batch),
+                    jax.tree_util.tree_leaves(self.batch_shardings),
+                )
+                if len(np.shape(l)) > dim
+            ),
+            None,
+        )
+        if pair is None:
+            raise ValueError("per-host input needs at least one batched leaf")
+        shape, sharding = pair
+        rows = shape[dim]
+        me = jax.process_index()
+        owned = sorted(
+            {
+                r
+                for dev, idx in sharding.devices_indices_map(shape).items()
+                if dev.process_index == me
+                for r in range(
+                    idx[dim].start or 0,
+                    rows if idx[dim].stop is None else idx[dim].stop,
+                )
+            }
+        )
+        lo, hi = owned[0], owned[-1] + 1
+        if owned != list(range(lo, hi)):
+            raise ValueError(
+                f"per-host input needs a contiguous local batch range; "
+                f"process {me} owns non-contiguous rows {owned[:8]}..."
+            )
+        rows_per_shard = rows // num_shards
+        if lo % rows_per_shard or hi % rows_per_shard:
+            raise ValueError(
+                f"process-local rows [{lo},{hi}) are not aligned to "
+                f"{rows_per_shard} rows/shard; pick input_shards such that "
+                "shards don't straddle processes"
+            )
+        return lo // rows_per_shard, hi // rows_per_shard, num_shards
+
+    def _make_shard_batch(self, step: int, shard_lo: int, shard_hi: int,
+                          num_shards: int):
+        """Synthesize this process's input shards for one step. Each shard
+        draws from a fresh generator seeded by (seed, step, shard) — order-
+        independent and thread-safe by construction (no cross-call rng
+        state), unlike the replicated path's sequential stream."""
+        shard_size = self.task.batch_size // num_shards
+        dim = self._batch_dim()
+        parts = [
+            self.prepare_batch(
+                self.task.make_batch(
+                    np.random.default_rng(
+                        np.random.SeedSequence(
+                            [self.config.seed, step, s]
+                        )
+                    ),
+                    shard_size,
+                )
+            )
+            for s in range(shard_lo, shard_hi)
+        ]
+        if len(parts) == 1:
+            return parts[0]
+        return jax.tree_util.tree_map(
+            lambda *xs: xs[0]
+            if np.ndim(xs[0]) == 0
+            else np.concatenate(xs, axis=dim),
+            *parts,
+        )
+
+    def _put_global(self, host_tree, shardings, stack: int = 0):
+        """Move a host batch to devices. Single-process (including the
+        per-host emulation, where local rows == all rows): plain
+        ``device_put``. Multi-process per-host: each process holds only
+        its local rows, so assemble the global array with
+        ``jax.make_array_from_process_local_data``. ``stack`` > 0 means
+        the tree is a [k, ...] stack of prepared batches."""
+        if jax.process_count() == 1 or not getattr(self, "_per_host_active", False):
+            return jax.device_put(host_tree, shardings)
+        # flattened zip (not tree_map): global-shape TUPLES would
+        # themselves be flattened as pytrees
+        flat_data, treedef = jax.tree_util.tree_flatten(host_tree)
+        flat_sh = jax.tree_util.tree_leaves(shardings)
+        flat_gs = [
+            np.shape(l) for l in jax.tree_util.tree_leaves(self._example_batch)
+        ]
+        if stack:
+            flat_gs = [(stack, *g) for g in flat_gs]
+        out = [
+            jax.make_array_from_process_local_data(s, np.asarray(d), g)
+            for d, s, g in zip(flat_data, flat_sh, flat_gs)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
     # -- multi-step device loop --------------------------------------------
 
     def _stacked_batch_shardings(self):
@@ -466,11 +630,35 @@ class Trainer:
                 state = ckpt.restore(state)
                 log.info("%s: resumed at step %d", self.task.name, int(state.step))
 
-        np_rng = np.random.default_rng(cfg.seed + int(state.step))
         history: List[Dict[str, float]] = []
         start_step = int(state.step)
         batch_shardings = self.batch_shardings
         stacked_shardings = self.stacked_batch_shardings
+
+        input_mode = cfg.input_mode or (
+            "per_host" if jax.process_count() > 1 else "replicated"
+        )
+        if input_mode not in ("replicated", "per_host"):
+            raise ValueError(f"unknown input_mode {cfg.input_mode!r}")
+        self._per_host_active = input_mode == "per_host"
+        if self._per_host_active:
+            shard_lo, shard_hi, num_shards = self._input_shard_plan()
+            # surfaced for tests/operators: which input shards THIS
+            # process synthesizes (disjoint across the gang)
+            self.input_shard_range = (shard_lo, shard_hi, num_shards)
+            log.info(
+                "%s: per-host input — process %d/%d builds shards "
+                "[%d, %d) of %d",
+                self.task.name, jax.process_index(), jax.process_count(),
+                shard_lo, shard_hi, num_shards,
+            )
+        else:
+            # Replicated batch stream. The generator is created HERE and
+            # owned EXCLUSIVELY by the batch producer — the prefetch
+            # thread when prefetching, this thread otherwise (numpy
+            # Generators are not thread-safe; nothing else may touch it
+            # while fit runs).
+            np_rng = np.random.default_rng(cfg.seed + int(state.step))
 
         prof_start = start_step + cfg.profile_skip if cfg.profile_dir else -1
         prof_stop = prof_start + cfg.profile_steps
@@ -478,7 +666,9 @@ class Trainer:
         # one base key for the run; the jitted step folds in state.step
         base_key = jax.random.key(cfg.seed)
 
-        def _make_host_batch(_step: int):
+        def _make_host_batch(step: int):
+            if self._per_host_active:
+                return self._make_shard_batch(step, shard_lo, shard_hi, num_shards)
             return self.prepare_batch(
                 self.task.make_batch(np_rng, self.task.batch_size)
             )
@@ -532,16 +722,16 @@ class Trainer:
                 if ckpt and cfg.checkpoint_every:
                     k = min(k, cfg.checkpoint_every - step % cfg.checkpoint_every)
                 if k == 1:
-                    # device_put stays on THIS thread (see
+                    # device transfer stays on THIS thread (see
                     # _BatchPrefetcher); it is an async enqueue
-                    batch = jax.device_put(_next_batch(step), batch_shardings)
+                    batch = self._put_global(_next_batch(step), batch_shardings)
                     state, metrics = self._step_fn(state, batch, base_key)
                 else:
                     stacked = jax.tree_util.tree_map(
                         lambda *xs: np.stack(xs),
                         *[_next_batch(step + i) for i in range(k)],
                     )
-                    batch = jax.device_put(stacked, stacked_shardings)
+                    batch = self._put_global(stacked, stacked_shardings, stack=k)
                     state, ys = self._chunk_fn(k)(state, batch, base_key)
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], ys)
                 step += k
@@ -549,19 +739,33 @@ class Trainer:
                 # holds k staged batches, so it weighs k against the bound
                 inflight.append((metrics["loss"], k))
                 inflight_steps = sum(w for _, w in inflight)
-                while inflight and inflight_steps > max_inflight:
-                    old_loss, w = inflight.popleft()
-                    inflight_steps -= w
-                    jax.block_until_ready(old_loss)
+                if inflight_steps > max_inflight:
+                    # Drain to HALF the window with ONE host fetch on the
+                    # newest drained entry: device completion is ordered,
+                    # so its arrival implies everything older is done.
+                    # A host fetch (not block_until_ready — through the
+                    # remote-execution tunnel that returns before device
+                    # work drains, BENCH_BASELINE.json note) per POPPED
+                    # step would cost a full round trip each (~50-90 ms
+                    # measured); amortizing to one per half-window keeps
+                    # the bound with O(2/window) fetches per step.
+                    newest = None
+                    while inflight and inflight_steps > max_inflight // 2:
+                        newest, w = inflight.popleft()
+                        inflight_steps -= w
+                    if newest is not None:
+                        float(newest)
                 if profiling and step >= prof_stop:
-                    jax.block_until_ready(metrics["loss"])
+                    float(metrics["loss"])  # honest drain before stopping
                     jax.profiler.stop_trace()
                     profiling = False
                     log.info("%s: profile trace written to %s", self.task.name, cfg.profile_dir)
                 if ckpt and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
                     ckpt.save(step, state)
                 if step % cfg.log_every == 0 or step == cfg.steps:
-                    m = {k2: float(v) for k2, v in metrics.items()}
+                    # ONE batched transfer for the whole metrics dict
+                    # (per-scalar fetches cost a tunnel round trip each)
+                    m = self._fetch_metrics(metrics)
                     m["step"] = step
                     now = time.perf_counter()
                     m["steps_per_s"] = (step - start_step) / (now - t0)
@@ -706,6 +910,12 @@ def run_task(
             profile_dir=env.get("TFK8S_PROFILE_DIR", ""),
             grad_accum_steps=int(env.get("TFK8S_GRAD_ACCUM", "1")),
             scan_steps=int(env.get("TFK8S_SCAN_STEPS", "1")),
+            input_mode=env.get("TFK8S_INPUT_MODE") or None,
+            input_shards=(
+                int(env["TFK8S_INPUT_SHARDS"])
+                if env.get("TFK8S_INPUT_SHARDS")
+                else None
+            ),
         )
 
     trainer = Trainer(task, config, mesh)

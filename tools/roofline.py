@@ -1,0 +1,288 @@
+"""Roofline probe harness — the committed, re-runnable evidence behind
+PERF_RESNET.md (VERDICT r3 next #2: "a perf claim this central must be
+one command away").
+
+Measures, on the CURRENT backend:
+
+- ``matmul_tflops``      — bf16 [n,n] matmul chain (MXU ceiling)
+- ``stream_bf16_gbps``   — elementwise read+write streaming, bf16
+- ``stream_f32_gbps``    — same in f32 (HBM-bandwidth ceiling as XLA
+                           fusions see it)
+- ``pallas_copy_gbps``   — a Pallas block-copy kernel (what hand-written
+                           kernel DMA achieves on this rig)
+- ``resnet_fwd_ms``      — ResNet-50 batch-256 forward only
+- ``resnet_gn_ablated_ms`` — full train step with every GroupNorm
+                           replaced by identity (models/resnet.ablate_norm)
+- ``resnet_step_ms``     — full train step (same probe bench.py times)
+
+Every timed region ends in a HOST FETCH of a device scalar — through the
+remote-execution tunnel ``block_until_ready`` returns early
+(BENCH_BASELINE.json note), so a transfer is the only honest barrier.
+Loop bodies thread their data through the scan carry so XLA cannot hoist
+the work out of the timed region (the round-3 measurement trap).
+
+Run standalone (``python tools/roofline.py``, one JSON line) or via
+``python bench.py --roofline``; the default bench run embeds this block
+in its output so every BENCH_r*.json records the platform envelope the
+headline claim is judged against. ``BENCH_SMALL=1`` shrinks shapes for a
+seconds-scale CPU smoke run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median_fetch(timed_once, windows: int = 3):
+    """(median_seconds, all_window_seconds) — bench.py's timing helper,
+    shared so the two measurement paths cannot drift."""
+    import bench
+
+    return bench._median_window(timed_once, windows)
+
+
+def _diff_seconds_per_iter(make_run, n1: int, n2: int) -> float:
+    """Per-iteration seconds via DIFFERENCING two scan lengths: the
+    remote-execution tunnel adds a large fixed cost per dispatched
+    window (~100 ms round trip measured — it swamped 8-iteration probes
+    at 2-3x error), and (t(n2) - t(n1)) / (n2 - n1) cancels any fixed
+    per-window overhead exactly. ``make_run(iters)`` returns a warmed
+    no-arg callable that runs AND host-syncs one window."""
+    run1, run2 = make_run(n1), make_run(n2)
+    t1, _ = _median_fetch(run1)
+    t2, _ = _median_fetch(run2)
+    return max(t2 - t1, 1e-12) / (n2 - n1)
+
+
+def matmul_tflops(n: int = 8192, n1: int = 8, n2: int = 40) -> float:
+    """bf16 matmul chain: the MXU ceiling this rig can reach."""
+    import jax
+    import jax.numpy as jnp
+
+    w = (jnp.eye(n, dtype=jnp.bfloat16)
+         + jnp.ones((n, n), jnp.bfloat16) * jnp.bfloat16(1e-3))
+    x = jnp.ones((n, n), jnp.bfloat16)
+
+    def make_run(iters):
+        def run(x, w):
+            def body(c, _):
+                # rescale so magnitudes stay O(1) across the chain
+                return (c @ w * jnp.bfloat16(0.5)).astype(jnp.bfloat16), ()
+
+            y = jax.lax.scan(body, x, None, length=iters)[0]
+            return jnp.sum(y.astype(jnp.float32))
+
+        run = jax.jit(run)
+        float(run(x, w))  # compile + warm
+        return lambda: float(run(x, w))
+
+    sec = _diff_seconds_per_iter(make_run, n1, n2)
+    return 2 * n**3 / sec / 1e12
+
+
+def stream_gbps(dtype_name: str, elems: int = 2**28,
+                n1: int = 8, n2: int = 72) -> float:
+    """Elementwise streaming: each iteration reads and writes the full
+    buffer once → bytes/iter = 2 * size."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    x = jnp.ones((elems,), dtype)
+
+    def make_run(iters):
+        def run(x):
+            def body(c, _):
+                return c + dtype(1), ()
+
+            y = jax.lax.scan(body, x, None, length=iters)[0]
+            # full reduction (not a slice): a sliceable output would let
+            # XLA shrink the streamed region and fake the number
+            return jnp.sum(y.astype(jnp.float32))
+
+        run = jax.jit(run)
+        float(run(x))
+        return lambda: float(run(x))
+
+    sec = _diff_seconds_per_iter(make_run, n1, n2)
+    nbytes = x.dtype.itemsize * elems
+    return 2 * nbytes / sec / 1e9
+
+
+def pallas_copy_gbps(rows: int = 8192, cols: int = 8192,
+                     n1: int = 4, n2: int = 36,
+                     block_rows: int = 64) -> Optional[float]:
+    """HBM→VMEM→HBM block copy as a Pallas kernel — the DMA bandwidth
+    hand-written kernels see (historically ~0.65x of the XLA streaming
+    number on this rig; PERF_RESNET.md §2). Block is 64 rows (2 MB f32):
+    in+out with double buffering must fit the 16 MB scoped-VMEM limit.
+    None if Pallas is unavailable on the backend."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    interpret = jax.devices()[0].platform not in ("tpu", "axon")
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    copy = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        interpret=interpret,
+    )
+
+    x = jnp.ones((rows, cols), jnp.float32)
+
+    def make_run(iters):
+        def run(x):
+            def body(c, _):
+                return copy(c), ()
+
+            y = jax.lax.scan(body, x, None, length=iters)[0]
+            return jnp.sum(y[:8, :8])  # copies can't be shrunk by slicing
+
+        run = jax.jit(run)
+        float(run(x))
+        return lambda: float(run(x))
+
+    try:
+        sec = _diff_seconds_per_iter(make_run, n1, n2)
+    except Exception as exc:  # noqa: BLE001 — backend without pallas
+        print(f"roofline: pallas copy probe unavailable: {exc}", file=sys.stderr)
+        return None
+    return 2 * rows * cols * 4 / sec / 1e9
+
+
+def _resnet_task_kw(small: bool) -> Dict:
+    if small:
+        return dict(depth=18, num_classes=8, image_size=32, width=8, batch_size=8)
+    return dict(depth=50, num_classes=1000, image_size=224, batch_size=256)
+
+
+def resnet_fwd_ms(small: bool, iters: int = 40) -> float:
+    """Forward-only ResNet step (loss, no grad/optimizer): isolates the
+    backward+update cost in the step-time decomposition."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfk8s_tpu.models import resnet
+    from tfk8s_tpu.parallel.mesh import make_mesh
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    task = resnet.make_task(**_resnet_task_kw(small))
+    mesh = make_mesh(data=jax.device_count())
+    trainer = Trainer(task, TrainConfig(steps=1), mesh)
+    state = trainer.init_state()
+    batch = jax.device_put(
+        task.make_batch(np.random.default_rng(0), task.batch_size),
+        trainer.batch_shardings,
+    )
+
+    def fwd(params, batch):
+        def body(carry, _):
+            # thread the carry into the INPUT so XLA cannot hoist the
+            # loop-invariant forward out of the scan (r3 timing trap)
+            b = jax.tree_util.tree_map(
+                lambda x: x + carry.astype(x.dtype) * 0
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x,
+                batch,
+            )
+            loss, _aux = task.loss_fn(params, b, jax.random.key(0))
+            return loss.astype(jnp.float32), ()
+
+        return jax.lax.scan(body, jnp.float32(0), None, length=iters)[0]
+
+    run = jax.jit(fwd)
+    float(run(state.params, batch))
+
+    sec, _ = _median_fetch(lambda: float(run(state.params, batch)))
+    return sec / iters * 1000
+
+
+def resnet_step_ms(small: bool, ablate_norm: bool = False,
+                   steps: Optional[int] = None) -> float:
+    """Full train step via bench.py's scanned timer; ``ablate_norm``
+    swaps every GroupNorm for identity (the memory-bound ablation:
+    PERF_RESNET.md §4's GN-ablated row)."""
+    import contextlib
+
+    import jax
+
+    import bench
+    from tfk8s_tpu.models import resnet
+    from tfk8s_tpu.parallel.mesh import make_mesh
+
+    steps = steps or (4 if small else 20)
+    scope = resnet.ablate_norm() if ablate_norm else contextlib.nullcontext()
+    with scope:
+        task = resnet.make_task(**_resnet_task_kw(small))
+        mesh = make_mesh(data=jax.device_count())
+        sec_per_step, _windows = bench._time_task(task, mesh, steps)
+        return sec_per_step * 1000
+
+
+def run_all(small: Optional[bool] = None,
+            include_resnet: bool = True) -> Dict:
+    """Every probe, one dict — the block bench.py embeds and
+    PERF_RESNET.md's tables regenerate from."""
+    import jax
+
+    if small is None:
+        small = os.environ.get("BENCH_SMALL") == "1"
+    if small:
+        mm_kw = dict(n=512, n1=2, n2=10)
+        st_kw = dict(elems=2**20, n1=2, n2=10)
+        pc_kw = dict(rows=256, cols=256, n1=2, n2=6, block_rows=64)
+        fwd_iters = 10
+    else:
+        mm_kw = dict(n=8192)
+        st_kw = dict(elems=2**28)
+        pc_kw = {}
+        fwd_iters = 40
+
+    out: Dict = {
+        "platform": jax.devices()[0].platform,
+        "n_chips": jax.device_count(),
+        "small": small,
+        "matmul_tflops": round(matmul_tflops(**mm_kw), 1),
+        "stream_bf16_gbps": round(stream_gbps("bf16", **st_kw), 1),
+        "stream_f32_gbps": round(stream_gbps("f32", **st_kw), 1),
+    }
+    pc = pallas_copy_gbps(**pc_kw)
+    if pc is not None:
+        out["pallas_copy_gbps"] = round(pc, 1)
+    if include_resnet:
+        out["resnet_fwd_ms"] = round(resnet_fwd_ms(small, iters=fwd_iters), 1)
+        out["resnet_gn_ablated_step_ms"] = round(
+            resnet_step_ms(small, ablate_norm=True), 1
+        )
+    return out
+
+
+def main() -> None:
+    if os.environ.get("BENCH_PLATFORM"):
+        from tfk8s_tpu.runtime.launcher import force_platform
+
+        force_platform(os.environ["BENCH_PLATFORM"])
+    # standalone runs include the full-step row too, so the memory-bound
+    # argument (step vs fwd vs GN-ablated vs stream) closes in one output
+    out = run_all()
+    out["resnet_step_ms"] = round(
+        resnet_step_ms(out["small"]), 1
+    )
+    print(json.dumps({"metric": "roofline", **out}))
+
+
+if __name__ == "__main__":
+    main()
