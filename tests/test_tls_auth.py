@@ -283,6 +283,23 @@ class TestKubeconfigFormats:
         build_ssl_context(cfg)
         assert len(remote_mod._staged_dirs) == before + 1
 
+    def test_dangling_current_context_rejected(self, tmp_path):
+        path = tmp_path / "dangling.yaml"
+        path.write_text(
+            "current-context: prod\n"
+            "clusters:\n"
+            "- name: staging\n"
+            "  cluster: {server: https://127.0.0.1:1}\n"
+            "contexts:\n"
+            "- name: staging\n"
+            "  context: {cluster: staging, user: op}\n"
+            "users:\n"
+            "- name: op\n"
+            "  user: {token: t}\n"
+        )
+        with pytest.raises(ValueError, match='current-context "prod"'):
+            load_kubeconfig(str(path))
+
     def test_bad_context_reference_rejected(self, tmp_path):
         path = tmp_path / "bad-ctx.yaml"
         path.write_text(

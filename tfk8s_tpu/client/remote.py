@@ -319,6 +319,12 @@ def _from_k8s_kubeconfig(data: Dict[str, Any]) -> Kubeconfig:
     if not clusters:
         raise ValueError("kubeconfig has no clusters")
     ctx_name = data.get("current-context") or next(iter(contexts), "")
+    if ctx_name and contexts and ctx_name not in contexts:
+        # a dangling current-context must error too — falling back to
+        # the first cluster would silently connect somewhere else
+        raise ValueError(
+            f'kubeconfig current-context "{ctx_name}" does not exist'
+        )
     ctx = contexts.get(ctx_name, {})
 
     def pick(pool: Dict[str, Any], ref: str, what: str) -> Dict[str, Any]:

@@ -299,6 +299,19 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
                   "plaintext HTTP")
         return 2
 
+    # the embedded credential must be able to WRITE (a kubelet or
+    # operator bootstrapped from this kubeconfig creates pods); resolve
+    # it BEFORE binding the listener so the error path leaks no socket
+    rw_token = None
+    if args.write_kubeconfig and auth and auth.tokens:
+        rw_token = next(
+            (t for t, u in auth.tokens.items() if not u.readonly), None
+        )
+        if rw_token is None:
+            log.error("--write-kubeconfig: token file has only "
+                      "readonly credentials; nothing usable to embed")
+            return 2
+
     server = APIServer(
         ClusterStore(), host=args.host, port=args.port, tls=tls, auth=auth
     )
@@ -306,18 +319,8 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
         kc: dict = {"server": server.url}
         if ca_pem:
             kc["certificate_authority_data"] = ca_pem
-        if auth and auth.tokens:
-            # the embedded credential must be able to WRITE (a kubelet or
-            # operator bootstrapped from this kubeconfig creates pods);
-            # a readonly first entry would fail far from its cause
-            rw = next(
-                (t for t, u in auth.tokens.items() if not u.readonly), None
-            )
-            if rw is None:
-                log.error("--write-kubeconfig: token file has only "
-                          "readonly credentials; nothing usable to embed")
-                return 2
-            kc["token"] = rw
+        if rw_token is not None:
+            kc["token"] = rw_token
         with open(args.write_kubeconfig, "w") as f:
             json.dump(kc, f)
     log.info("apiserver listening on %s", server.url)
