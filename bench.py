@@ -134,7 +134,8 @@ def _fit_step_time(task, mesh, steps: int) -> float:
 
 
 def _flash_speedup(seq: int = 2048, iters: int = 8, blocks=None,
-                   masked: bool = False):
+                   masked: bool = False, b: int = 8, h: int = 12,
+                   d: int = 64):
     """Train-shaped attention (fwd+bwd, bf16) at BERT-base head geometry:
     Pallas flash kernels vs the XLA einsum path. ``masked=False`` is the
     causal pretraining shape; ``masked=True`` exercises the key-padding
@@ -155,7 +156,6 @@ def _flash_speedup(seq: int = 2048, iters: int = 8, blocks=None,
             flash_attention, block_q=blocks[0], block_k=blocks[1]
         )
 
-    b, h, d = 8, 12, 64
     rng = np.random.default_rng(0)
     mk = lambda: jnp.asarray(rng.standard_normal((b, seq, h, d)), jnp.bfloat16)
     q, k, v = mk(), mk(), mk()
@@ -391,7 +391,7 @@ def main() -> None:
 
     # -- flash-attention win at long sequence (VERDICT r2 #4): autotuned
     # blocks, plus a REAL long-context model row (BERT seq-2048, flash)
-    flash_ms = xla_ms = mflash_ms = mxla_ms = None
+    flash_ms = xla_ms = mflash_ms = mxla_ms = f8k_ms = x8k_ms = None
     flash_blocks = None
     bert2k_sec = None
     if not small and os.environ.get("BENCH_FLASH", "1") == "1":
@@ -415,6 +415,21 @@ def main() -> None:
             print(f"bench: flash section failed: {exc}", file=sys.stderr)
             degraded.append("flash")
             flash_ms = mflash_ms = None
+        # long-context point: seq 8192 at b1/h4 — the regime flash exists
+        # for (the XLA reference materializes a 1 GB [b,h,L,L] scores
+        # buffer; flash stays O(L·d)). Degrades on its own.
+        if os.environ.get("BENCH_FLASH_LONG", "1") == "1":
+            try:
+                from tfk8s_tpu.ops.flash_attention import pick_blocks as _pb
+
+                lblocks = _pb(8192)
+                if lblocks is not None:
+                    f8k_ms, x8k_ms = _flash_speedup(
+                        seq=8192, iters=4, blocks=lblocks, b=1, h=4
+                    )
+            except Exception as exc:  # noqa: BLE001
+                print(f"bench: flash seq-8192 row failed: {exc}", file=sys.stderr)
+                degraded.append("flash_8k")
         if flash_blocks is not None and flash_ms is not None:
             # the model row degrades on its own — a failure here must not
             # discard the attention speedups already measured above
@@ -558,6 +573,19 @@ def main() -> None:
                             "flash_blocks": list(flash_blocks or ()),
                         }
                         if flash_ms
+                        else {}
+                    ),
+                    # the seq-8192 row stands on its own — a degraded
+                    # seq-2048 section must not drop it from the artifact
+                    **(
+                        {
+                            "flash_attn_seq8192_ms": round(f8k_ms, 3),
+                            "xla_attn_seq8192_ms": round(x8k_ms, 3),
+                            "flash_attn_seq8192_speedup": round(
+                                x8k_ms / f8k_ms, 3
+                            ),
+                        }
+                        if f8k_ms
                         else {}
                     ),
                     **(

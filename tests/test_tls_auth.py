@@ -300,6 +300,21 @@ class TestKubeconfigFormats:
         with pytest.raises(ValueError, match='current-context "prod"'):
             load_kubeconfig(str(path))
 
+        # same with NO contexts section at all — still an error, not a
+        # silent fallback to the first cluster
+        path2 = tmp_path / "no-contexts.yaml"
+        path2.write_text(
+            "current-context: prod\n"
+            "clusters:\n"
+            "- name: staging\n"
+            "  cluster: {server: https://127.0.0.1:1}\n"
+            "users:\n"
+            "- name: op\n"
+            "  user: {token: t}\n"
+        )
+        with pytest.raises(ValueError, match='current-context "prod"'):
+            load_kubeconfig(str(path2))
+
     def test_bad_context_reference_rejected(self, tmp_path):
         path = tmp_path / "bad-ctx.yaml"
         path.write_text(

@@ -319,7 +319,7 @@ def _from_k8s_kubeconfig(data: Dict[str, Any]) -> Kubeconfig:
     if not clusters:
         raise ValueError("kubeconfig has no clusters")
     ctx_name = data.get("current-context") or next(iter(contexts), "")
-    if ctx_name and contexts and ctx_name not in contexts:
+    if ctx_name and ctx_name not in contexts:
         # a dangling current-context must error too — falling back to
         # the first cluster would silently connect somewhere else
         raise ValueError(
