@@ -1,5 +1,7 @@
 """Model families (SURVEY.md §7 step 6, BASELINE.json config order):
-MNIST MLP, ResNet-50, BERT-base MLM, T5-base seq2seq, DLRM/Wide&Deep.
+MNIST MLP, ResNet-50, BERT-base MLM, T5-base seq2seq, DLRM/Wide&Deep —
+plus GPT-style causal LM (decoder-only autoregressive pretraining, the
+modern default workload) and the pipelined BERT variant.
 Each exposes ``make_task()`` (a runtime TrainTask) and a ``train`` TPUJob
 entrypoint.
 """

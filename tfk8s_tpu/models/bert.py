@@ -80,15 +80,27 @@ def base_config(**overrides) -> TransformerConfig:
     return TransformerConfig(**kw)
 
 
+def make_chain_tokens(
+    rng: np.random.Generator, batch_size: int, seq_len: int, vocab: int
+) -> np.ndarray:
+    """The hermetic token stream shared by the BERT and GPT families:
+    ``t[i+1] = (a*t[i] + b) mod V`` with random restarts — predictable
+    from a neighbor, so both MLM and next-token objectives are learnable
+    with zero dataset I/O. ONE copy so the families' documented
+    data-equivalence cannot drift."""
+    toks = np.empty((batch_size, seq_len), np.int64)
+    toks[:, 0] = rng.integers(1, vocab, size=batch_size)
+    restarts = rng.random((batch_size, seq_len)) < _RESTART_P
+    fresh = rng.integers(1, vocab, size=(batch_size, seq_len))
+    for i in range(1, seq_len):
+        nxt = (_CHAIN_A * toks[:, i - 1] + _CHAIN_B) % (vocab - 1) + 1
+        toks[:, i] = np.where(restarts[:, i], fresh[:, i], nxt)
+    return toks
+
+
 def make_batch_fn(vocab: int, seq_len: int):
     def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
-        toks = np.empty((batch_size, seq_len), np.int64)
-        toks[:, 0] = rng.integers(1, vocab, size=batch_size)
-        restarts = rng.random((batch_size, seq_len)) < _RESTART_P
-        fresh = rng.integers(1, vocab, size=(batch_size, seq_len))
-        for i in range(1, seq_len):
-            nxt = (_CHAIN_A * toks[:, i - 1] + _CHAIN_B) % (vocab - 1) + 1
-            toks[:, i] = np.where(restarts[:, i], fresh[:, i], nxt)
+        toks = make_chain_tokens(rng, batch_size, seq_len, vocab)
         mlm_mask = rng.random((batch_size, seq_len)) < MASK_RATE
         inputs = np.where(mlm_mask, MASK_ID, toks)
         return {
@@ -175,44 +187,16 @@ def task_for_mesh(
     cfg.attention_impl == 'flash' — or by default on TPU once the
     sequence length crosses FLASH_SEQ_THRESHOLD (the XLA path's [L, L]
     scores buffer starts dominating HBM; flash's is O(L·d))."""
-    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE, AXIS_TENSOR
-    from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
-    from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn
-    # NB: the ops package re-exports the flash_attention *function*,
-    # shadowing the submodule attribute — import symbols from the
-    # submodule directly.
-    from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
+    from tfk8s_tpu.models.transformer import select_attn_fn
 
     cfg = cfg or base_config()
-    seq_sharded = (
-        AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
-    )
     # The EFFECTIVE length — make_task clamps to cfg.max_len — decides
     # the impl; flash's kernel additionally needs the length to divide
     # its q/k blocks, so auto-selection picks the largest dividing
     # candidates via pick_blocks (any 128-multiple length qualifies).
     # Explicit cfg.attention_impl == "flash" trusts the caller's blocks.
     seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
-    if cfg.attention_impl == "ring":
-        attn_fn = make_ring_attn_fn(mesh)
-    elif cfg.attention_impl == "ulysses":
-        attn_fn = make_ulysses_attn_fn(mesh)
-    elif seq_sharded:
-        if cfg.attention_impl != "auto":
-            # an explicit full/flash pin cannot serve a sequence-sharded
-            # mesh — refuse rather than silently substituting an SP impl
-            raise ValueError(
-                f"attention_impl={cfg.attention_impl!r} pinned on a "
-                "sequence-sharded mesh; sequence parallelism needs "
-                "'auto', 'ring', or 'ulysses'"
-            )
-        h_local = cfg.num_heads // mesh.shape.get(AXIS_TENSOR, 1)
-        if h_local % mesh.shape[AXIS_SEQUENCE] == 0:
-            attn_fn = make_ulysses_attn_fn(mesh)
-        else:
-            attn_fn = make_ring_attn_fn(mesh)
-    else:
-        attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
+    attn_fn = select_attn_fn(mesh, cfg, seq_len)
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 

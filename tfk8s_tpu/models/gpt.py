@@ -1,0 +1,197 @@
+"""GPT-style decoder-only causal LM — the autoregressive pretraining
+family (beyond BASELINE.json's five configs; the modern default workload
+a TPU training framework must serve).
+
+The model is the shared encoder stack (models/transformer.py) with
+``causal=True`` layers and a tied output head; next-token cross-entropy
+over shifted targets. Every attention impl composes through the same
+mesh policy as BERT (``transformer.select_attn_fn``): XLA, Pallas
+flash (causal kernels, bottom-right aligned), ring attention on long
+sequence-sharded meshes (the causal ring skips above-diagonal blocks),
+and Ulysses. Gradients all-reduce over ``data`` as XLA collectives.
+
+Hermetic data: the same fixed affine chain as BERT
+(``t[i+1] = (a*t[i] + b) mod V`` with random restarts) WITHOUT masking —
+the next token is deterministic except at restarts, so causal LM loss
+falls to the restart-entropy floor fast and convergence is testable
+without a corpus. The reference has no model code at all
+(k8s-operator.md:6); this is data-plane surface the north star requires.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from tfk8s_tpu.models.transformer import (
+    Embedder,
+    EncoderLayer,
+    TransformerConfig,
+    _ln,
+    apply_with_aux,
+    maybe_remat,
+)
+from tfk8s_tpu.runtime.train import TrainTask, run_task
+
+
+
+class GPTLM(nn.Module):
+    """Decoder-only causal LM: embedder + N causal pre-LN blocks + tied
+    head. ``attn_fn`` swaps the inner attention (flash/ring/ulysses)."""
+
+    cfg: TransformerConfig
+    attn_fn: Optional[Any] = None
+
+    def setup(self):
+        self.embed = Embedder(self.cfg, name="embed")
+        layer = maybe_remat(EncoderLayer, self.cfg)
+        self.layers = [
+            layer(
+                self.cfg,
+                attn_fn=self.attn_fn,
+                use_moe=self.cfg.layer_uses_moe(i),
+                causal=True,
+                name=f"layer{i}",
+            )
+            for i in range(self.cfg.num_layers)
+        ]
+        self.ln_final = _ln("ln_final")
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        x = self.embed(ids)
+        for layer in self.layers:
+            x = layer(x)
+        x = self.ln_final(x).astype(self.cfg.dtype)
+        return self.embed.logits(x)  # [b, l, vocab], fp32
+
+
+def base_config(**overrides) -> TransformerConfig:
+    """GPT-2-small shape: 12 layers / 768 hidden / 12 heads / 3072 mlp."""
+    kw = dict(
+        vocab_size=32000, embed_dim=768, num_heads=12, head_dim=64,
+        mlp_dim=3072, num_layers=12, max_len=1024,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """Test-scale config (runs in seconds on the CPU backend)."""
+    kw = dict(
+        vocab_size=64, embed_dim=32, num_heads=4, head_dim=8,
+        mlp_dim=64, num_layers=2, max_len=64,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def make_batch_fn(vocab: int, seq_len: int):
+    from tfk8s_tpu.models.bert import make_chain_tokens
+
+    def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
+        toks = make_chain_tokens(rng, batch_size, seq_len, vocab)
+        return {"input": toks.astype(np.int32)}
+
+    return make_batch
+
+
+def lm_loss_and_metrics(
+    logits: jax.Array, ids: jax.Array
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token objective: position i predicts token i+1 (the final
+    position has no target and is dropped)."""
+    shift_logits = logits[:, :-1]
+    shift_targets = ids[:, 1:]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        shift_logits, shift_targets
+    )
+    loss = jnp.mean(per_tok)
+    acc = jnp.mean(
+        (jnp.argmax(shift_logits, -1) == shift_targets).astype(jnp.float32)
+    )
+    return loss, {"next_token_accuracy": acc}
+
+
+def make_task(
+    cfg: Optional[TransformerConfig] = None,
+    seq_len: int = 128,
+    batch_size: int = 64,
+    targets: Optional[Dict[str, float]] = None,
+    attn_fn: Optional[Any] = None,
+) -> TrainTask:
+    cfg = cfg or base_config()
+    seq_len = min(seq_len, cfg.max_len)
+    model = GPTLM(cfg, attn_fn=attn_fn)
+
+    def init(rng):
+        # full batch shape: ring attention's shard_map needs the batch dim
+        # divisible by the data axis even at trace time
+        return model.init(rng, jnp.zeros((batch_size, seq_len), jnp.int32))["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits, aux = apply_with_aux(model, cfg, params, batch["input"])
+        loss, metrics = lm_loss_and_metrics(logits, batch["input"])
+        if cfg.num_experts > 0:
+            metrics["moe_aux"] = aux
+            loss = loss + cfg.moe_aux_weight * aux
+        return loss, metrics
+
+    return TrainTask(
+        name="gpt-lm",
+        init=init,
+        loss_fn=loss_fn,
+        make_batch=make_batch_fn(cfg.vocab_size, seq_len),
+        batch_size=batch_size,
+        targets=targets or {},
+    )
+
+
+def task_for_mesh(
+    mesh,
+    cfg: Optional[TransformerConfig] = None,
+    **task_kw,
+) -> TrainTask:
+    """Build the task with the attention impl the mesh calls for — the
+    SAME policy as BERT (``transformer.select_attn_fn``); causal
+    masking rides inside each impl (flash's bottom-right-aligned kernels,
+    the ring's src-indexed block masks, Ulysses' global mask)."""
+    from tfk8s_tpu.models.transformer import select_attn_fn
+
+    cfg = cfg or base_config()
+    seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
+    attn_fn = select_attn_fn(mesh, cfg, seq_len)
+    return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
+
+
+def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint: ``tfk8s_tpu.models.gpt:train``.
+    ``TFK8S_MODEL_PRESET=tiny`` selects the test-scale config;
+    ``TFK8S_ATTENTION_IMPL`` pins an attention impl; ``TFK8S_NUM_EXPERTS``
+    > 0 enables MoE layers over the ``expert`` mesh axis."""
+    from tfk8s_tpu.runtime.launcher import (
+        ProcessContext,
+        build_mesh,
+        initialize_distributed,
+    )
+
+    env = dict(env)
+    env.setdefault("TFK8S_TRAIN_STEPS", "100")
+    env.setdefault("TFK8S_LEARNING_RATE", "1e-4")
+    seq = int(env.get("TFK8S_SEQ_LEN", "128"))
+    batch = int(env.get("TFK8S_BATCH_SIZE", "64"))
+    preset = tiny_config if env.get("TFK8S_MODEL_PRESET") == "tiny" else base_config
+    cfg = preset(
+        num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
+        moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
+        attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"),
+    )
+    ctx = ProcessContext.from_env(env)
+    initialize_distributed(ctx, env)
+    mesh = build_mesh(ctx)
+    task = task_for_mesh(mesh, cfg=cfg, seq_len=seq, batch_size=batch)
+    run_task(task, env, stop, mesh=mesh)

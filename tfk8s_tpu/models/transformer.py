@@ -179,17 +179,22 @@ class EncoderLayer(nn.Module):
 
     With ``use_moe`` the MLP is a SwitchMoeBlock; its load-balance aux
     loss is sown into the ``"losses"`` collection (task wrappers apply
-    with ``mutable=["losses"]`` and fold it into the objective)."""
+    with ``mutable=["losses"]`` and fold it into the objective).
+    ``causal=True`` turns the block into a decoder-only (GPT-style)
+    layer — same stack, autoregressive attention."""
 
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
     use_moe: bool = False
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h = _ln("ln_attn")(x).astype(cfg.dtype)
-        x = x + MultiHeadAttention(cfg, attn_fn=self.attn_fn, name="attn")(h, mask=mask)
+        x = x + MultiHeadAttention(
+            cfg, causal=self.causal, attn_fn=self.attn_fn, name="attn"
+        )(h, mask=mask)
         h = _ln("ln_mlp")(x).astype(cfg.dtype)
         if self.use_moe:
             from tfk8s_tpu.parallel.moe import SwitchMoeBlock
@@ -309,6 +314,52 @@ def maybe_remat(layer_cls, cfg: TransformerConfig):
     if cfg.remat:
         return nn.remat(layer_cls, prevent_cse=False)
     return layer_cls
+
+
+def select_attn_fn(mesh, cfg: TransformerConfig, seq_len: int):
+    """The mesh-driven attention-impl policy shared by the BERT and GPT
+    families' ``task_for_mesh`` (one copy so their selection cannot
+    drift). T5 deliberately keeps its OWN policy: its enc-dec attention
+    carries key-padding masks, which the ring kernel does not support —
+    routing T5 through this function would silently drop padding masks
+    whenever the head count forces the ring branch (models/t5.py).
+
+    On a sequence-sharded mesh: Ulysses head-all-to-all SP while the
+    sequence degree divides the per-device head count, ring attention
+    beyond it; explicit 'ring'/'ulysses' pins honored anywhere, explicit
+    'full'/'flash' pins REJECTED on a sequence-sharded mesh (never
+    silently substituted). Otherwise the Pallas flash kernel per
+    ops/flash_attention.auto_flash_attn_fn (explicit 'flash', or auto on
+    TPU past FLASH_SEQ_THRESHOLD)."""
+    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE, AXIS_TENSOR
+    from tfk8s_tpu.parallel.ring_attention import make_ring_attn_fn
+    from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn
+    # NB: the ops package re-exports the flash_attention *function*,
+    # shadowing the submodule attribute — import symbols from the
+    # submodule directly.
+    from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
+
+    seq_sharded = (
+        AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
+    )
+    if cfg.attention_impl == "ring":
+        return make_ring_attn_fn(mesh)
+    if cfg.attention_impl == "ulysses":
+        return make_ulysses_attn_fn(mesh)
+    if seq_sharded:
+        if cfg.attention_impl != "auto":
+            # an explicit full/flash pin cannot serve a sequence-sharded
+            # mesh — refuse rather than silently substituting an SP impl
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} pinned on a "
+                "sequence-sharded mesh; sequence parallelism needs "
+                "'auto', 'ring', or 'ulysses'"
+            )
+        h_local = cfg.num_heads // mesh.shape.get(AXIS_TENSOR, 1)
+        if h_local % mesh.shape[AXIS_SEQUENCE] == 0:
+            return make_ulysses_attn_fn(mesh)
+        return make_ring_attn_fn(mesh)
+    return auto_flash_attn_fn(cfg.attention_impl, seq_len)
 
 
 class Encoder(nn.Module):
