@@ -41,12 +41,16 @@ MASK_RATE = 0.15
 
 
 class BertWithHead(nn.Module):
-    """Encoder + tied-embedding MLM head, exposed as one module so the
+    """Encoder + tied-embedding head, exposed as one module so the
     embedding table is shared naturally. ``attn_fn`` swaps the inner
-    attention computation (ring attention on sequence-sharded meshes)."""
+    attention computation (ring attention on sequence-sharded meshes);
+    ``causal=True`` makes every layer autoregressive — the SAME stack
+    serves the BERT (bidirectional MLM) and GPT (decoder-only LM)
+    families, so wiring fixes cannot drift between them."""
 
     cfg: TransformerConfig
     attn_fn: Optional[Any] = None
+    causal: bool = False
 
     def setup(self):
         self.embed = Embedder(self.cfg, name="embed")
@@ -56,6 +60,7 @@ class BertWithHead(nn.Module):
                 self.cfg,
                 attn_fn=self.attn_fn,
                 use_moe=self.cfg.layer_uses_moe(i),
+                causal=self.causal,
                 name=f"layer{i}",
             )
             for i in range(self.cfg.num_layers)

@@ -26,48 +26,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from flax import linen as nn
 
-from tfk8s_tpu.models.transformer import (
-    Embedder,
-    EncoderLayer,
-    TransformerConfig,
-    _ln,
-    apply_with_aux,
-    maybe_remat,
-)
+from tfk8s_tpu.models.transformer import TransformerConfig, apply_with_aux
 from tfk8s_tpu.runtime.train import TrainTask, run_task
 
 
 
-class GPTLM(nn.Module):
-    """Decoder-only causal LM: embedder + N causal pre-LN blocks + tied
-    head. ``attn_fn`` swaps the inner attention (flash/ring/ulysses)."""
+def GPTLM(cfg: TransformerConfig, attn_fn: Optional[Any] = None):
+    """Decoder-only causal LM: the SHARED BertWithHead stack with
+    ``causal=True`` — one module serves both families (a wiring fix to
+    the stack cannot miss one of them). Returns a flax module instance;
+    the factory shape keeps the GPT-side name without duplicating the
+    class."""
+    from tfk8s_tpu.models.bert import BertWithHead
 
-    cfg: TransformerConfig
-    attn_fn: Optional[Any] = None
-
-    def setup(self):
-        self.embed = Embedder(self.cfg, name="embed")
-        layer = maybe_remat(EncoderLayer, self.cfg)
-        self.layers = [
-            layer(
-                self.cfg,
-                attn_fn=self.attn_fn,
-                use_moe=self.cfg.layer_uses_moe(i),
-                causal=True,
-                name=f"layer{i}",
-            )
-            for i in range(self.cfg.num_layers)
-        ]
-        self.ln_final = _ln("ln_final")
-
-    def __call__(self, ids: jax.Array) -> jax.Array:
-        x = self.embed(ids)
-        for layer in self.layers:
-            x = layer(x)
-        x = self.ln_final(x).astype(self.cfg.dtype)
-        return self.embed.logits(x)  # [b, l, vocab], fp32
+    return BertWithHead(cfg, attn_fn=attn_fn, causal=True)
 
 
 def base_config(**overrides) -> TransformerConfig:
