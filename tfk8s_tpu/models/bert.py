@@ -51,6 +51,9 @@ class BertWithHead(nn.Module):
     cfg: TransformerConfig
     attn_fn: Optional[Any] = None
     causal: bool = False
+    # incremental KV-cache generation (transformer.MultiHeadAttention
+    # decode path); only meaningful with causal=True
+    decode: bool = False
 
     def setup(self):
         self.embed = Embedder(self.cfg, name="embed")
@@ -61,14 +64,20 @@ class BertWithHead(nn.Module):
                 attn_fn=self.attn_fn,
                 use_moe=self.cfg.layer_uses_moe(i),
                 causal=self.causal,
+                decode=self.decode,
                 name=f"layer{i}",
             )
             for i in range(self.cfg.num_layers)
         ]
         self.ln_final = _ln("ln_final")
 
-    def __call__(self, ids: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
-        x = self.embed(ids)
+    def __call__(
+        self,
+        ids: jax.Array,
+        mask: Optional[jax.Array] = None,
+        pos_offset: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        x = self.embed(ids, pos_offset=pos_offset)
         for layer in self.layers:
             x = layer(x, mask)
         x = self.ln_final(x).astype(self.cfg.dtype)

@@ -124,6 +124,79 @@ def make_task(
     )
 
 
+def init_cache(cfg: TransformerConfig, batch_size: int):
+    """A CLEAN KV cache (zero buffers, index 0) for incremental decode.
+    Never use ``decoder.init(...)["cache"]`` directly: flax runs the
+    module body during init, so that cache already holds the init
+    token's K/V with cache_index=1 — position 0 would be garbage."""
+    from tfk8s_tpu.models.bert import BertWithHead
+
+    decoder = BertWithHead(cfg, causal=True, decode=True)
+    shapes = jax.eval_shape(
+        lambda: decoder.init(
+            jax.random.key(0), jnp.zeros((batch_size, 1), jnp.int32)
+        )["cache"]
+    )
+    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
+
+
+def greedy_generate(
+    cfg: TransformerConfig,
+    params,
+    prompt: jax.Array,  # [b, prompt_len] int32
+    num_tokens: int,
+) -> jax.Array:
+    """Jit-compatible greedy decoding with the KV cache: ONE ``lax.scan``
+    over prompt_len + num_tokens single-token steps (prefill and
+    generation share the loop — uniform trip, static shapes, no
+    recompilation per position). Returns the ``[b, num_tokens]``
+    continuation.
+
+    The cache holds fixed ``[b, max_len, h, d]`` K/V buffers per layer
+    (transformer.MultiHeadAttention decode path), so each step is
+    O(L·d) attention against the filled prefix — the standard
+    autoregressive-serving memory/compute shape on TPU."""
+    b, prompt_len = prompt.shape
+    total = prompt_len + num_tokens
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt_len + num_tokens = {total} exceeds max_len={cfg.max_len}"
+        )
+    from tfk8s_tpu.models.bert import BertWithHead
+
+    decoder = BertWithHead(cfg, causal=True, decode=True)
+    cache = init_cache(cfg, b)
+    # prompt extended with a zero tail so the scan can index one stream
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((b, num_tokens), prompt.dtype)], axis=1
+    )
+
+    def step(carry, i):
+        cache, tok = carry
+        logits, mut = decoder.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            pos_offset=i,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(prompt.dtype)
+        # while still inside the prompt, feed the next PROMPT token;
+        # afterwards feed the model's own prediction
+        in_prompt = i + 1 < prompt_len
+        forced = jax.lax.dynamic_slice_in_dim(
+            tokens, jnp.minimum(i + 1, total - 1), 1, axis=1
+        )[:, 0]
+        nxt_in = jnp.where(in_prompt, forced, nxt)
+        return (mut["cache"], nxt_in), nxt
+
+    (_, _), outs = jax.lax.scan(
+        step, (cache, tokens[:, 0]), jnp.arange(total)
+    )
+    # outs[i] is the prediction for position i+1; the continuation starts
+    # at position prompt_len, predicted at step prompt_len-1
+    return jnp.swapaxes(outs, 0, 1)[:, prompt_len - 1 : total - 1]
+
+
 def task_for_mesh(
     mesh,
     cfg: Optional[TransformerConfig] = None,

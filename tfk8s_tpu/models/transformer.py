@@ -103,11 +103,19 @@ def _dense(features, names, name, dtype, axis=-1, partition=True):
 
 class MultiHeadAttention(nn.Module):
     """Self- or cross-attention. ``attn_fn`` lets the caller swap the
-    inner softmax(QK^T)V computation (e.g. for ring attention)."""
+    inner softmax(QK^T)V computation (e.g. for ring attention).
+
+    ``decode=True`` enables the autoregressive KV cache: each call
+    appends this step's K/V at ``cache_index`` into fixed
+    ``[b, max_len, h, d]`` buffers (the ``"cache"`` variable collection)
+    and attends over the filled prefix — static shapes throughout, so
+    the whole generation loop jits as one ``lax.scan`` (SURVEY.md 'XLA
+    semantics': no dynamic shapes)."""
 
     cfg: TransformerConfig
     causal: bool = False
     attn_fn: Optional[Callable] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(
@@ -124,7 +132,61 @@ class MultiHeadAttention(nn.Module):
         v = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "v", cfg.dtype, partition=part)(kv)
         q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
 
-        if self.attn_fn is not None:
+        if self.decode:
+            b, step_len, h, d = k.shape
+            # token-at-a-time generation: a multi-token decode step would
+            # need an intra-step causal mask this path deliberately omits
+            # (ValueError, not assert — python -O must not disable the
+            # guard against silent future leakage)
+            if step_len != 1:
+                raise ValueError(
+                    f"decode mode is incremental (one token per call); "
+                    f"got a {step_len}-token step"
+                )
+            if mask is not None:
+                # padded prompts would write pad K/V into the cache and
+                # the prefix mask would make them attendable — corrupting
+                # every later token silently; refuse instead
+                raise ValueError(
+                    "decode mode does not support padding masks; feed "
+                    "unpadded per-row prompts (mask=None)"
+                )
+            cached_k = self.variable(
+                "cache", "cached_key",
+                jnp.zeros, (b, cfg.max_len, h, d), k.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                jnp.zeros, (b, cfg.max_len, h, d), v.dtype,
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx = cache_index.value
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k, (0, idx, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v, (0, idx, 0, 0)
+            )
+            cached_k.value, cached_v.value = k_all, v_all
+            cache_index.value = idx + step_len
+            # only the filled prefix (positions <= current) is visible —
+            # this IS the causal mask in incremental form
+            valid = (
+                jnp.arange(cfg.max_len)[None, :] < idx + step_len
+            )
+            out = dot_product_attention(
+                q, k_all, v_all,
+                mask=jnp.broadcast_to(valid, (b, cfg.max_len)),
+                causal=False,
+            )
+            # past max_len the write index would clamp and the prefix
+            # mask would cover a corrupted cache — poison the output
+            # instead of returning plausible-looking garbage (idx is
+            # traced, so a Python raise can't fire here)
+            out = jnp.where(idx < cfg.max_len, out, jnp.nan)
+        elif self.attn_fn is not None:
             out = self.attn_fn(q, k, v, mask=mask, causal=self.causal)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=self.causal)
@@ -187,13 +249,15 @@ class EncoderLayer(nn.Module):
     attn_fn: Optional[Callable] = None
     use_moe: bool = False
     causal: bool = False
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         h = _ln("ln_attn")(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
-            cfg, causal=self.causal, attn_fn=self.attn_fn, name="attn"
+            cfg, causal=self.causal, attn_fn=self.attn_fn,
+            decode=self.decode, name="attn"
         )(h, mask=mask)
         h = _ln("ln_mlp")(x).astype(cfg.dtype)
         if self.use_moe:
@@ -271,7 +335,9 @@ class Embedder(nn.Module):
             jnp.float32,
         )
 
-    def __call__(self, ids: jax.Array) -> jax.Array:
+    def __call__(
+        self, ids: jax.Array, pos_offset: Optional[jax.Array] = None
+    ) -> jax.Array:
         # Gather-before-use (FSDP convention): reshard the table/pos
         # params to embed-replicated BEFORE the lookup — a cheap rank-2
         # param all-gather over ``fsdp`` — so the [b,l,e] activation is
@@ -279,13 +345,22 @@ class Embedder(nn.Module):
         # table's fsdp'd embed dim and GSPMD later needs an
         # activation-layout flip it can only do by involuntary full
         # rematerialization (observed on dp×fsdp×tp meshes).
+        # ``pos_offset`` (possibly traced) shifts the positional slice —
+        # incremental decode feeds one token at absolute position offset.
+        def pos_slice(pos):
+            if pos_offset is None:
+                return pos[: ids.shape[-1]]
+            return jax.lax.dynamic_slice_in_dim(
+                pos, pos_offset, ids.shape[-1], axis=0
+            )
+
         if self.cfg.partition_params:
             table = act_constraint(self.tok.embedding, "vocab", None)
             pos = act_constraint(self.pos, None, None)
-            x = jnp.take(table, ids, axis=0) + pos[: ids.shape[-1]]
+            x = jnp.take(table, ids, axis=0) + pos_slice(pos)
             x = act_constraint(x, "batch", "seq", "embed")
         else:
-            x = self.tok(ids) + self.pos[: ids.shape[-1]]
+            x = self.tok(ids) + pos_slice(self.pos)
         return x.astype(self.cfg.dtype)
 
     def logits(self, x: jax.Array) -> jax.Array:
