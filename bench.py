@@ -257,6 +257,55 @@ def _tunnel_probes(task, mesh):
     return rtt, enqueue, max(h2d_total - rtt, 0.0), batch_bytes
 
 
+def _gpt_decode_ms_per_token(small: bool):
+    """Autoregressive serving shape: greedy KV-cache decoding
+    (models/gpt.greedy_generate — one jitted lax.scan, so the whole
+    generation is a single dispatch through the tunnel). Returns
+    (ms_per_token_step, tokens_per_sec_aggregate) at GPT-2-small shape
+    (batch 8), random params — decode cost is shape-, not value-,
+    dependent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfk8s_tpu.models import gpt
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    if small:
+        cfg = gpt.tiny_config(max_len=48)
+        batch, prompt_len, num_tokens = 2, 16, 16
+    else:
+        cfg = gpt.base_config(max_len=1024)
+        batch, prompt_len, num_tokens = 8, 128, 128
+    task = gpt.make_task(cfg=cfg, seq_len=prompt_len, batch_size=batch)
+    params = unbox(task.init(jax.random.key(0)))
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, (batch, prompt_len)),
+        jnp.int32,
+    )
+
+    run = jax.jit(
+        lambda p, pr: gpt.greedy_generate(cfg, p, pr, num_tokens=num_tokens)
+    )
+    out = run(params, prompt)
+    np.asarray(out)  # compile + warm, honest host barrier
+
+    def timed_once():
+        np.asarray(run(params, prompt))
+
+    sec, windows = _median_window(timed_once)
+    steps = prompt_len + num_tokens  # token-at-a-time prefill + generation
+    # throughput counts GENERATED tokens only over end-to-end time
+    # (prompt positions are input, not output — counting them would
+    # double the published serving rate); per-step time is uniform, so
+    # ms_per_token covers prefill and decode alike
+    return (
+        sec / steps * 1000,
+        batch * num_tokens / sec,
+        [w / steps * 1000 for w in windows],
+    )
+
+
 _PROBE_CODE = """
 import os
 if os.environ.get("BENCH_PLATFORM"):
@@ -444,6 +493,19 @@ def main() -> None:
                 print(f"bench: bert2k row failed: {exc}", file=sys.stderr)
                 degraded.append("bert2k")
 
+    # -- serving shape: KV-cache greedy decode (models/gpt.py). Runs in
+    # small mode too (rc coverage) but the gpt2-named keys are only
+    # emitted at the FULL config — a tiny-config number published under
+    # a gpt2 key would read as massive drift vs the baseline ------------
+    gpt_ms_tok = gpt_tok_s = None
+    gpt_windows: list = []
+    if os.environ.get("BENCH_GPT_DECODE", "1") == "1":
+        try:
+            gpt_ms_tok, gpt_tok_s, gpt_windows = _gpt_decode_ms_per_token(small)
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: gpt decode row failed: {exc}", file=sys.stderr)
+            degraded.append("gpt_decode")
+
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     baseline_note = {}
@@ -545,8 +607,25 @@ def main() -> None:
                     "bert_seq_len": bert_seq,
                     "resnet_batch_size": rn_task.batch_size,
                     "n_chips": n_chips,
+                    **(
+                        {
+                            "gpt2_decode_ms_per_token": round(gpt_ms_tok, 3),
+                            "gpt2_decode_tokens_per_sec": round(gpt_tok_s, 1),
+                        }
+                        if gpt_ms_tok is not None and not small
+                        else {}
+                    ),
                     # self-described noise floor (VERDICT r3 next #9)
                     "noise": {
+                        **(
+                            {
+                                "gpt_decode_step_windows_ms": [
+                                    round(w, 3) for w in gpt_windows
+                                ]
+                            }
+                            if gpt_windows and not small
+                            else {}
+                        ),
                         "windows_per_metric": _WINDOWS,
                         "resnet_step_windows_ms": [
                             round(w * 1000, 2) for w in rn_windows
