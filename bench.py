@@ -261,9 +261,9 @@ def _gpt_decode_ms_per_token(small: bool):
     """Autoregressive serving shape: greedy KV-cache decoding
     (models/gpt.greedy_generate — one jitted lax.scan, so the whole
     generation is a single dispatch through the tunnel). Returns
-    (ms_per_token_step, tokens_per_sec_aggregate) at GPT-2-small shape
-    (batch 8), random params — decode cost is shape-, not value-,
-    dependent."""
+    (ms_per_token_step, generated_tokens_per_sec, per_window_ms_list) at
+    GPT-2-small shape (batch 8), random params — decode cost is shape-,
+    not value-, dependent."""
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -145,10 +145,18 @@ def test_flash_pin_matches_full():
     )
 
 
-def test_kv_cache_decode_matches_full_forward():
+import pytest
+
+
+@pytest.mark.parametrize("cache_len", [None, 12])
+def test_kv_cache_decode_matches_full_forward(cache_len):
     """THE decode correctness property: feeding tokens one at a time
     through the KV cache reproduces the full forward's logits at every
-    position (same params, fp32)."""
+    position (same params, fp32) — with the default max_len-sized buffer
+    AND a right-sized one (decode_cache_len < max_len, the serving
+    fast path)."""
+    import dataclasses
+
     from tfk8s_tpu.models.bert import BertWithHead
 
     cfg = gpt.tiny_config(dtype=jnp.float32, max_len=32)
@@ -159,8 +167,9 @@ def test_kv_cache_decode_matches_full_forward():
     params = model.init(jax.random.key(0), ids)["params"]
     full = model.apply({"params": params}, ids)  # [b, 12, V]
 
-    decoder = BertWithHead(cfg, causal=True, decode=True)
-    cache = gpt.init_cache(cfg, 2)  # NOT init(...)["cache"] — that's dirty
+    dcfg = dataclasses.replace(cfg, decode_cache_len=cache_len)
+    decoder = BertWithHead(dcfg, causal=True, decode=True)
+    cache = gpt.init_cache(dcfg, 2)  # NOT init(...)["cache"] — that's dirty
     for i in range(ids.shape[1]):
         step_logits, mut = decoder.apply(
             {"params": params, "cache": cache},

@@ -71,6 +71,14 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
+    # KV-cache buffer length for incremental decode (None = max_len).
+    # Right-size it to the REQUEST (prompt + generation): the per-step
+    # cache update/attention traffic scales with the BUFFER length, not
+    # the filled length — measured 2.5x decode speedup at 256 vs 1024 on
+    # the bench chip. Decoupled from max_len because the positional
+    # table is a PARAM shaped [max_len, embed] (trained checkpoints pin
+    # it), while the cache is ephemeral serving state.
+    decode_cache_len: Optional[int] = None
     # False drops the flax Partitioned boxes from layer params. Needed
     # inside manual-collective regions (shard_map pipeline stages): flax
     # re-runs initializers under eval_shape at apply time, and a boxed
@@ -151,13 +159,14 @@ class MultiHeadAttention(nn.Module):
                     "decode mode does not support padding masks; feed "
                     "unpadded per-row prompts (mask=None)"
                 )
+            cache_len = cfg.decode_cache_len or cfg.max_len
             cached_k = self.variable(
                 "cache", "cached_key",
-                jnp.zeros, (b, cfg.max_len, h, d), k.dtype,
+                jnp.zeros, (b, cache_len, h, d), k.dtype,
             )
             cached_v = self.variable(
                 "cache", "cached_value",
-                jnp.zeros, (b, cfg.max_len, h, d), v.dtype,
+                jnp.zeros, (b, cache_len, h, d), v.dtype,
             )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -174,18 +183,18 @@ class MultiHeadAttention(nn.Module):
             # only the filled prefix (positions <= current) is visible —
             # this IS the causal mask in incremental form
             valid = (
-                jnp.arange(cfg.max_len)[None, :] < idx + step_len
+                jnp.arange(cache_len)[None, :] < idx + step_len
             )
             out = dot_product_attention(
                 q, k_all, v_all,
-                mask=jnp.broadcast_to(valid, (b, cfg.max_len)),
+                mask=jnp.broadcast_to(valid, (b, cache_len)),
                 causal=False,
             )
-            # past max_len the write index would clamp and the prefix
+            # past the buffer the write index would clamp and the prefix
             # mask would cover a corrupted cache — poison the output
             # instead of returning plausible-looking garbage (idx is
             # traced, so a Python raise can't fire here)
-            out = jnp.where(idx < cfg.max_len, out, jnp.nan)
+            out = jnp.where(idx < cache_len, out, jnp.nan)
         elif self.attn_fn is not None:
             out = self.attn_fn(q, k, v, mask=mask, causal=self.causal)
         else:
