@@ -142,17 +142,74 @@ def init_cache(cfg: TransformerConfig, batch_size: int):
     return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
 
 
-def greedy_generate(
+def filter_logits(
+    logits: jax.Array,  # [b, vocab] float
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Nucleus/top-k logit filtering for sampled decode, jit-safe (static
+    shapes, no data-dependent control flow — it runs inside the decode
+    scan). Disallowed tokens go to -inf; the surviving set is:
+
+    - ``top_k > 0``: only the k highest-scoring tokens;
+    - ``top_p < 1``: the smallest prefix of the descending-probability
+      ordering whose cumulative mass reaches p (the argmax token always
+      survives, so the filter can never empty the distribution).
+
+    Both filters compose (intersection), matching the common serving
+    semantics (HF ``top_k``+``top_p``)."""
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    # ONE descending sort serves both filters — this runs per token
+    # inside the decode scan, and a second O(V log V) sort at 32k vocab
+    # would double the filter's hot-path cost
+    sorted_desc = (
+        jnp.sort(logits, axis=-1)[:, ::-1]
+        if (top_k and top_k > 0) or top_p < 1.0
+        else None
+    )
+    if top_k and top_k > 0:
+        kth = sorted_desc[:, min(top_k, logits.shape[-1]) - 1][:, None]
+        logits = jnp.where(logits < kth, neg, logits)
+        if top_p < 1.0:  # apply the same cut to the sorted view
+            sorted_desc = jnp.where(sorted_desc < kth, neg, sorted_desc)
+    if top_p < 1.0:
+        sorted_logits = sorted_desc
+        probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep while the mass BEFORE this token is still < p (exclusive
+        # cumsum) — the first token is always kept
+        keep_sorted = (cum - probs) < top_p
+        # threshold = score of the last kept token in the ordering; every
+        # token scoring below it is cut. Ties at the threshold survive
+        # together — acceptable (standard) nucleus behavior.
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+        )[:, None]
+        logits = jnp.where(logits < thresh, neg, logits)
+    return logits
+
+
+def generate(
     cfg: TransformerConfig,
     params,
     prompt: jax.Array,  # [b, prompt_len] int32
     num_tokens: int,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
-    """Jit-compatible greedy decoding with the KV cache: ONE ``lax.scan``
-    over prompt_len + num_tokens single-token steps (prefill and
-    generation share the loop — uniform trip, static shapes, no
+    """Jit-compatible KV-cache decoding — greedy or sampled — as ONE
+    ``lax.scan`` over prompt_len + num_tokens single-token steps (prefill
+    and generation share the loop — uniform trip, static shapes, no
     recompilation per position). Returns the ``[b, num_tokens]``
     continuation.
+
+    ``rng=None`` (or ``temperature=0``) is greedy argmax. Otherwise
+    tokens are drawn from ``softmax(filter_logits(logits / temperature,
+    top_k, top_p))`` with a per-step key folded from ``rng`` — the whole
+    sampled path stays inside the single compiled scan, so serving cost
+    is the same one dispatch as greedy.
 
     The per-layer K/V buffers are ``[b, cache_len, h, d]`` with
     cache_len RIGHT-SIZED to this request (prompt + generation) — the
@@ -188,6 +245,7 @@ def greedy_generate(
     tokens = jnp.concatenate(
         [prompt, jnp.zeros((b, num_tokens), prompt.dtype)], axis=1
     )
+    sampled = rng is not None and temperature > 0.0
 
     def step(carry, i):
         cache, tok = carry
@@ -197,7 +255,16 @@ def greedy_generate(
             pos_offset=i,
             mutable=["cache"],
         )
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(prompt.dtype)
+        step_logits = logits[:, 0].astype(jnp.float32)
+        if sampled:
+            step_logits = filter_logits(
+                step_logits / temperature, top_k=top_k, top_p=top_p
+            )
+            nxt = jax.random.categorical(
+                jax.random.fold_in(rng, i), step_logits, axis=-1
+            ).astype(prompt.dtype)
+        else:
+            nxt = jnp.argmax(step_logits, axis=-1).astype(prompt.dtype)
         # while still inside the prompt, feed the next PROMPT token;
         # afterwards feed the model's own prediction
         in_prompt = i + 1 < prompt_len
@@ -213,6 +280,16 @@ def greedy_generate(
     # outs[i] is the prediction for position i+1; the continuation starts
     # at position prompt_len, predicted at step prompt_len-1
     return jnp.swapaxes(outs, 0, 1)[:, prompt_len - 1 : total - 1]
+
+
+def greedy_generate(
+    cfg: TransformerConfig,
+    params,
+    prompt: jax.Array,
+    num_tokens: int,
+) -> jax.Array:
+    """Greedy argmax decoding — ``generate`` without an rng."""
+    return generate(cfg, params, prompt, num_tokens)
 
 
 def task_for_mesh(
