@@ -1,0 +1,260 @@
+"""File-backed input pipeline (tfk8s_tpu/data): TFRecord framing + crc32c
+integrity, native C++ reader vs pure-Python fallback parity, per-host
+file sharding, the prefetching dataset, and end-to-end training from
+record shards on the CPU mesh."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from tfk8s_tpu.data import (
+    RecordDataset,
+    RecordFile,
+    RecordIOError,
+    RecordWriter,
+    crc32c,
+    decode,
+    encode,
+    masked_crc32c,
+    shard_files,
+)
+from tfk8s_tpu.data import _native
+
+
+def _write(path, records):
+    with RecordWriter(path) as w:
+        for r in records:
+            w.write(r)
+
+
+@pytest.fixture
+def force_pure_py(monkeypatch):
+    """Route every codepath through the pure-Python backend."""
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_tried", True)
+
+
+def test_crc32c_known_vector():
+    # the canonical crc32c check value (RFC 3720 §B.4)
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+
+
+def test_crc32c_python_matches_native(force_pure_py):
+    # recompute the known vector through the table fallback
+    assert crc32c(b"123456789") == 0xE3069283
+    data = np.random.default_rng(0).bytes(4097)
+    py = crc32c(data)
+    # un-force and compare against the native lib when it builds here
+    _native._tried = False
+    _native._lib = None
+    lib = _native.load()
+    if lib is not None:
+        assert int(lib.rio_crc32c(data, len(data))) == py
+
+
+def test_native_library_builds_on_this_rig():
+    """The image ships g++ — the native core must actually build here
+    (elsewhere the fallback is legitimate; on this rig a silent fallback
+    would hide a build break)."""
+    assert _native.load() is not None
+
+
+def test_roundtrip_and_framing(tmp_path):
+    recs = [b"hello", b"", b"x" * 70000, np.random.default_rng(1).bytes(333)]
+    path = str(tmp_path / "a.rio")
+    _write(path, recs)
+    rf = RecordFile(path)
+    assert len(rf) == len(recs)
+    assert rf.read(range(len(recs))) == recs
+    assert list(rf) == recs
+    # TFRecord wire framing, verified against an independent reader
+    with open(path, "rb") as f:
+        hdr = f.read(12)
+    (length,) = struct.unpack("<Q", hdr[:8])
+    assert length == 5
+    assert struct.unpack("<I", hdr[8:])[0] == masked_crc32c(hdr[:8])
+
+
+def test_python_and_native_readers_agree(tmp_path, force_pure_py):
+    recs = [os.urandom(n) for n in (1, 100, 5000)]
+    path = str(tmp_path / "b.rio")
+    _write(path, recs)  # pure-python writer
+    py_rf = RecordFile(path)
+    py_out = py_rf.read(range(3))
+    _native._tried = False
+    _native._lib = None
+    if _native.load() is None:
+        pytest.skip("no native toolchain")
+    nat_rf = RecordFile(path)
+    assert (nat_rf.offsets, nat_rf.lengths) == (py_rf.offsets, py_rf.lengths)
+    assert nat_rf.read(range(3)) == py_out
+
+
+@pytest.mark.parametrize("backend", ["native", "python"])
+def test_corruption_detected(tmp_path, backend, monkeypatch):
+    if backend == "python":
+        monkeypatch.setattr(_native, "_lib", None)
+        monkeypatch.setattr(_native, "_tried", True)
+    elif _native.load() is None:
+        pytest.skip("no native toolchain")
+    path = str(tmp_path / "c.rio")
+    _write(path, [b"alpha", b"bravo", b"charlie"])
+    rf = RecordFile(path)
+
+    # flip a byte inside record 1's data -> data CRC mismatch on read
+    raw = bytearray(open(path, "rb").read())
+    raw[rf.offsets[1] + 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(RecordIOError, match="crc mismatch at record 1"):
+        RecordFile(path).read([0, 1, 2])
+    # unverified read is the explicit escape hatch: returns the (corrupt)
+    # bytes instead of raising
+    unverified = RecordFile(path).read([1], verify=False)[0]
+    assert len(unverified) == 5 and unverified != b"bravo"
+
+    # corrupt a length header -> indexing itself fails
+    raw[8] ^= 0xFF  # inside record 0's masked length CRC
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(RecordIOError):
+        RecordFile(path)
+
+    # truncated tail -> loud error, not a silent short file
+    _write(path, [b"alpha", b"bravo"])
+    full = open(path, "rb").read()
+    open(path, "wb").write(full[:-3])
+    with pytest.raises(RecordIOError, match="truncat"):
+        RecordFile(path)
+
+
+def test_example_codec_roundtrip():
+    ex = {
+        "input": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "label": np.asarray(7, np.int64),
+        "weights": np.random.default_rng(0).standard_normal((2, 2)).astype(
+            np.float32
+        ),
+    }
+    out = decode(encode(ex))
+    assert out.keys() == ex.keys()
+    for k in ex:
+        assert out[k].dtype == ex[k].dtype and out[k].shape == ex[k].shape
+        np.testing.assert_array_equal(out[k], ex[k])
+    with pytest.raises(ValueError, match="bad magic"):
+        decode(b"nope" + b"\x00" * 10)
+    with pytest.raises(ValueError, match="truncat"):
+        decode(encode(ex)[:-5])
+
+
+def test_shard_files_disjoint_and_covering():
+    files = [f"/d/part-{i:03d}" for i in range(10)]
+    shards = [shard_files(files, i, 4) for i in range(4)]
+    flat = [f for s in shards for f in s]
+    assert sorted(flat) == sorted(files)
+    assert len(set(flat)) == len(files)
+    # deterministic regardless of input order
+    assert shard_files(list(reversed(files)), 2, 4) == shards[2]
+    with pytest.raises(ValueError, match="cannot feed"):
+        shard_files(files[:3], 0, 4)
+    with pytest.raises(ValueError, match="not in"):
+        shard_files(files, 4, 4)
+
+
+def _write_example_shards(tmp_path, n_files=4, per_file=16, seq=8, vocab=32):
+    rng = np.random.default_rng(0)
+    files = []
+    for fi in range(n_files):
+        path = str(tmp_path / f"part-{fi:02d}.rio")
+        with RecordWriter(path) as w:
+            for ri in range(per_file):
+                toks = rng.integers(1, vocab, size=(seq,), dtype=np.int32)
+                toks[0] = fi * per_file + ri  # tag: provenance check
+                w.write(encode({"input": toks}))
+        files.append(path)
+    return files
+
+
+def test_dataset_epochs_deterministic_and_reshuffled(tmp_path):
+    files = _write_example_shards(tmp_path)
+    ds = RecordDataset(files, batch_size=8, seed=3)
+    assert len(ds) == 64
+    e0a = [b["input"].copy() for b in ds.batches(0)]
+    e0b = [b["input"].copy() for b in ds.batches(0)]
+    e1 = [b["input"].copy() for b in ds.batches(1)]
+    assert all(a.shape == (8, 8) for a in e0a)
+    for a, b in zip(e0a, e0b):
+        np.testing.assert_array_equal(a, b)  # same epoch -> same order
+    assert not all(
+        np.array_equal(a, b) for a, b in zip(e0a, e1)
+    ), "epoch 1 must reshuffle"
+    # every record seen exactly once per epoch (tags are unique)
+    tags = sorted(int(row[0]) for a in e0a for row in a)
+    assert tags == list(range(64))
+
+
+def test_dataset_per_host_sharding_partitions_records(tmp_path):
+    files = _write_example_shards(tmp_path)
+    seen = []
+    for host in range(2):
+        ds = RecordDataset(
+            files, batch_size=8, host_index=host, num_hosts=2, shuffle=False
+        )
+        assert len(ds) == 32
+        seen.append(
+            {int(b["input"][r, 0]) for b in ds.batches(0) for r in range(8)}
+        )
+    assert seen[0].isdisjoint(seen[1])
+    assert sorted(seen[0] | seen[1]) == list(range(64))
+
+
+def test_prefetch_iterator_cycles_and_closes(tmp_path):
+    files = _write_example_shards(tmp_path, n_files=1, per_file=8)
+    ds = RecordDataset(files, batch_size=4, num_hosts=1, seed=0)
+    it = ds.iterator(prefetch=2)
+    batches = [next(it) for _ in range(5)]  # > one epoch (2 batches/epoch)
+    assert all(b["input"].shape == (4, 8) for b in batches)
+    it.close()
+
+    fn = ds.as_batch_fn()
+    out = fn(None, 4)
+    assert out["input"].shape == (4, 8)
+    with pytest.raises(ValueError, match="built for batch_size"):
+        fn(None, 16)
+    fn.close()
+
+
+def test_train_task_from_record_dataset(tmp_path):
+    """End to end: GPT chain data written to record shards, read back
+    through the dataset as the TrainTask's batch source, loss falls."""
+    import jax
+
+    from tfk8s_tpu.models import gpt
+    from tfk8s_tpu.models.bert import make_chain_tokens
+    from tfk8s_tpu.parallel.mesh import make_mesh
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    cfg = gpt.tiny_config()
+    rng = np.random.default_rng(0)
+    files = []
+    for fi in range(2):
+        path = str(tmp_path / f"train-{fi}.rio")
+        with RecordWriter(path) as w:
+            for _ in range(64):
+                toks = make_chain_tokens(rng, 1, 32, cfg.vocab_size)[0]
+                w.write(encode({"input": toks.astype(np.int32)}))
+        files.append(path)
+
+    ds = RecordDataset(files, batch_size=16, seed=1)
+    base = gpt.make_task(cfg=cfg, seq_len=32, batch_size=16)
+    import dataclasses
+
+    task = dataclasses.replace(base, make_batch=ds.as_batch_fn())
+    mesh = make_mesh(data=8)
+    trainer = Trainer(
+        task, TrainConfig(steps=120, learning_rate=3e-3, log_every=60), mesh
+    )
+    _state, history = trainer.fit()
+    assert history[0]["loss"] > history[-1]["loss"]
+    assert history[-1]["next_token_accuracy"] > 0.4, history[-1]

@@ -1,0 +1,27 @@
+"""File-backed input pipeline: TFRecord-framed shards (crc32c), a native
+C++ reader core with a pure-Python fallback, per-host file sharding, and
+a prefetching batched dataset (see ``recordio``, ``example``,
+``dataset``)."""
+
+from tfk8s_tpu.data.dataset import RecordDataset
+from tfk8s_tpu.data.example import decode, encode
+from tfk8s_tpu.data.recordio import (
+    RecordFile,
+    RecordIOError,
+    RecordWriter,
+    crc32c,
+    masked_crc32c,
+    shard_files,
+)
+
+__all__ = [
+    "RecordDataset",
+    "RecordFile",
+    "RecordIOError",
+    "RecordWriter",
+    "crc32c",
+    "decode",
+    "encode",
+    "masked_crc32c",
+    "shard_files",
+]

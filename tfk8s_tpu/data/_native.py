@@ -1,0 +1,100 @@
+"""Lazy g++ build + ctypes binding for the native record-IO core
+(``native/recordio.cc``).
+
+The shared object is compiled on first use into a cache directory keyed
+by the source hash (``$TFK8S_NATIVE_CACHE``, else
+``~/.cache/tfk8s-tpu``), so rebuilds happen exactly when the source
+changes and concurrent builders race benignly (atomic rename). Rigs
+without a toolchain — or ``TFK8S_PURE_PY=1`` — fall back to the
+pure-Python codec in ``recordio.py``; every capability has both paths
+and the tests assert they agree."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "native", "recordio.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("TFK8S_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tfk8s-tpu"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[str]:
+    src = open(_SRC, "rb").read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"recordio-{tag}.so")
+    if os.path.exists(out):
+        return out
+    # build to a temp name, rename into place: concurrent processes
+    # (pytest-xdist, multi-host launch on a shared home) each build their
+    # own temp and the last rename wins with identical bytes
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_cache_dir())
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound native library, or None (toolchain missing / disabled).
+    Build + bind happen once per process; the result is latched."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        if os.environ.get("TFK8S_PURE_PY") == "1":
+            _tried = True
+            return None
+        path = _build()
+        if path is None:
+            _tried = True
+            return None
+        lib = ctypes.CDLL(path)
+        i64, u32 = ctypes.c_int64, ctypes.c_uint32
+        pi64 = ctypes.POINTER(i64)
+        pu8 = ctypes.POINTER(ctypes.c_uint8)
+        lib.rio_crc32c.restype = u32
+        lib.rio_crc32c.argtypes = [ctypes.c_char_p, i64]
+        lib.rio_masked_crc32c.restype = u32
+        lib.rio_masked_crc32c.argtypes = [ctypes.c_char_p, i64]
+        lib.rio_index.restype = i64
+        lib.rio_index.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(pi64), ctypes.POINTER(pi64)
+        ]
+        lib.rio_free.restype = None
+        lib.rio_free.argtypes = [ctypes.c_void_p]
+        lib.rio_read.restype = i64
+        lib.rio_read.argtypes = [
+            ctypes.c_char_p, i64, pi64, pi64, pu8, ctypes.c_int, pi64
+        ]
+        lib.rio_write.restype = i64
+        lib.rio_write.argtypes = [ctypes.c_char_p, i64, pu8, pi64]
+        _lib = lib
+        _tried = True
+        return _lib
