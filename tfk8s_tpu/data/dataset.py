@@ -56,6 +56,12 @@ class RecordDataset:
         ]
         if not self._addr:
             raise ValueError(f"no records in shard set {self.files}")
+        if drop_remainder and len(self._addr) < batch_size:
+            raise ValueError(
+                f"shard set {self.files} holds {len(self._addr)} records — "
+                f"fewer than one batch of {batch_size} (drop_remainder) — "
+                "write more records or rebalance files across hosts"
+            )
 
     def __len__(self) -> int:
         return len(self._addr)
@@ -68,14 +74,21 @@ class RecordDataset:
             ).shuffle(idx)
         return idx
 
-    def batches(self, epoch: int):
-        """Yield stacked host batches for one epoch, in the seeded order.
-        Reads are grouped per shard file within each batch (one native
-        bulk read per file touched)."""
+    def batches_per_epoch(self) -> int:
+        n = len(self._addr)
+        if self.drop_remainder:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def batches(self, epoch: int, start_batch: int = 0):
+        """Yield stacked host batches for one epoch, in the seeded order,
+        starting at batch ``start_batch`` (skipped batches are index
+        arithmetic — no file reads). Reads are grouped per shard file
+        within each batch (one native bulk read per file touched)."""
         order = self._epoch_order(epoch)
         n = len(order)
         stop = n - (n % self.batch_size) if self.drop_remainder else n
-        for lo in range(0, stop, self.batch_size):
+        for lo in range(start_batch * self.batch_size, stop, self.batch_size):
             take = order[lo : lo + self.batch_size]
             yield self._load(take)
 
@@ -102,15 +115,18 @@ class RecordDataset:
                 )
         return {k: np.stack([ex[k] for ex in examples]) for k in keys}
 
-    def iterator(self, prefetch: int = 2):
+    def iterator(self, prefetch: int = 2, start_batch: int = 0):
         """An endless batch iterator cycling epochs. ``prefetch > 0``
         runs a background producer thread keeping that many decoded
         batches staged; ``prefetch=0`` is synchronous (for consumers
-        that bring their own overlap). ``.close()`` it (or let it be
-        GC'd) to stop any producer."""
+        that bring their own overlap). ``start_batch`` fast-forwards to
+        that global batch index (epoch = index // batches_per_epoch)
+        without reading the skipped records — checkpoint resume lands on
+        the exact batch the restarted step would have seen. ``.close()``
+        it (or let it be GC'd) to stop any producer."""
         if prefetch <= 0:
-            return _SyncIterator(self)
-        return _PrefetchIterator(self, prefetch)
+            return _SyncIterator(self, start_batch)
+        return _PrefetchIterator(self, prefetch, start_batch)
 
     def as_batch_fn(self, prefetch: int = 0):
         """Adapter to ``TrainTask.make_batch(np_rng, batch_size)``: the
@@ -141,10 +157,11 @@ class RecordDataset:
 class _SyncIterator:
     """Endless epoch-cycling batch iterator, no threads."""
 
-    def __init__(self, ds: RecordDataset):
+    def __init__(self, ds: RecordDataset, start_batch: int = 0):
         self._ds = ds
-        self._epoch = 0
-        self._gen = ds.batches(0)
+        bpe = ds.batches_per_epoch()
+        self._epoch = start_batch // bpe
+        self._gen = ds.batches(self._epoch, start_batch % bpe)
         self._closed = False
 
     def __iter__(self):
@@ -165,8 +182,9 @@ class _SyncIterator:
 
 
 class _PrefetchIterator:
-    def __init__(self, ds: RecordDataset, prefetch: int):
+    def __init__(self, ds: RecordDataset, prefetch: int, start_batch: int = 0):
         self._ds = ds
+        self._start_batch = start_batch
         self._q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
         self._stop = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -176,10 +194,11 @@ class _PrefetchIterator:
         self._thread.start()
 
     def _produce(self) -> None:
-        epoch = 0
+        bpe = self._ds.batches_per_epoch()
+        epoch, within = self._start_batch // bpe, self._start_batch % bpe
         try:
             while not self._stop.is_set():
-                for batch in self._ds.batches(epoch):
+                for batch in self._ds.batches(epoch, within):
                     while not self._stop.is_set():
                         try:
                             self._q.put(batch, timeout=0.1)
@@ -189,6 +208,7 @@ class _PrefetchIterator:
                     if self._stop.is_set():
                         return
                 epoch += 1
+                within = 0
         except BaseException as exc:  # surface IO/decode errors to consumer
             self._exc = exc
             self._stop.set()

@@ -83,24 +83,37 @@ class RecordWriter:
         if not self._pending:
             return
         recs = self._pending
-        lib = _native.load()
-        if lib is not None:
-            blob = b"".join(recs)
-            lens = (ctypes.c_int64 * len(recs))(*[len(r) for r in recs])
-            buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
-            rc = lib.rio_write(self.path.encode(), len(recs), buf, lens)
-            if rc != 0:
-                raise RecordIOError(f"native write failed rc={rc}: {self.path}")
-        else:
-            with open(self.path, "ab") as f:
-                for r in recs:
-                    hdr = struct.pack("<Q", len(r))
-                    f.write(hdr)
-                    f.write(struct.pack("<I", masked_crc32c(hdr)))
-                    f.write(r)
-                    f.write(struct.pack("<I", masked_crc32c(r)))
-        # cleared only AFTER the write lands: a failed flush keeps the
-        # records buffered so a retrying caller doesn't silently lose them
+        # retry safety: on ANY failure, roll the file back to its
+        # pre-flush size AND keep the records buffered — a retried
+        # flush() then neither drops records nor appends duplicates of a
+        # partial write
+        pre_size = os.path.getsize(self.path)
+        try:
+            lib = _native.load()
+            if lib is not None:
+                blob = b"".join(recs)
+                lens = (ctypes.c_int64 * len(recs))(*[len(r) for r in recs])
+                buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+                rc = lib.rio_write(self.path.encode(), len(recs), buf, lens)
+                if rc != 0:
+                    raise RecordIOError(
+                        f"native write failed rc={rc}: {self.path}"
+                    )
+            else:
+                with open(self.path, "ab") as f:
+                    for r in recs:
+                        hdr = struct.pack("<Q", len(r))
+                        f.write(hdr)
+                        f.write(struct.pack("<I", masked_crc32c(hdr)))
+                        f.write(r)
+                        f.write(struct.pack("<I", masked_crc32c(r)))
+        except BaseException:
+            try:
+                with open(self.path, "rb+") as f:
+                    f.truncate(pre_size)
+            except OSError:
+                pass  # the original error is the one to surface
+            raise
         self._pending = []
 
     def close(self) -> None:

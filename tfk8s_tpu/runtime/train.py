@@ -136,16 +136,31 @@ class TrainConfig:
     #   global array is assembled with
     #   ``jax.make_array_from_process_local_data`` — host input work and
     #   memory scale 1/hosts.
-    # None = auto: "per_host" when jax.process_count() > 1.
+    # - "files": batches come from RECORD SHARDS (tfk8s_tpu/data) named by
+    #   ``input_files`` instead of the task's synthetic make_batch; on
+    #   multi-process runs each process opens ONLY its round-robin share
+    #   of the file list and reads just its addressable rows' worth of
+    #   records per step (the TF_CONFIG-era per-task input division over
+    #   real files), assembled with make_array_from_process_local_data.
+    #   Record order is the dataset's seeded epoch shuffle; resume
+    #   fast-forwards the iterator to the restart step without reading
+    #   the skipped records.
+    # None = auto: "files" when input_files is set, else "per_host" when
+    # jax.process_count() > 1.
     # The per_host batch content depends only on (seed, step, input_shards)
     # — NOT on the process topology — so any process count produces the
     # same global stream (a single process can emulate any shard layout
     # bit-for-bit; tests/test_distributed.py proves 1-proc == 2-proc).
+    # (files mode makes no such topology-independence claim: the file→host
+    # assignment changes with the process count.)
     input_mode: Optional[str] = None
     # number of logical input shards in per_host mode (None = process
     # count); must divide batch_size (and batch_size/input_shards must be
     # a multiple of grad_accum_steps)
     input_shards: Optional[int] = None
+    # comma-separated record-file paths/globs for input_mode="files"
+    # (TFK8S_INPUT_FILES); examples must decode to the task's batch schema
+    input_files: Optional[str] = None
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer is not None:
@@ -171,6 +186,54 @@ def _suffix_match_shardings(abstract_tree, params_paths, mesh):
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(one, abstract_tree)
+
+
+class _CheckedFileStream:
+    """Iterator adapter over a RecordDataset iterator that validates the
+    FIRST decoded batch against the task's batch schema (structure,
+    per-row shapes, dtypes, local row count) — a records/task mismatch
+    must fail with a schema message, not a shape error deep inside jit."""
+
+    def __init__(self, it, want_example, local_rows: int):
+        self._it = it
+        self._want = want_example
+        self._rows = local_rows
+        self._checked = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        raw = next(self._it)
+        if not self._checked:
+            self._checked = True
+            got_def = jax.tree_util.tree_structure(raw)
+            want_def = jax.tree_util.tree_structure(self._want)
+            if got_def != want_def:
+                raise ValueError(
+                    f"record schema {got_def} does not match the task's "
+                    f"batch schema {want_def}"
+                )
+            for g, w in zip(
+                jax.tree_util.tree_leaves(raw),
+                jax.tree_util.tree_leaves(self._want),
+            ):
+                ga, wa = np.asarray(g), np.asarray(w)
+                if ga.shape[1:] != wa.shape[1:] or ga.dtype != wa.dtype:
+                    raise ValueError(
+                        "record example mismatch: got "
+                        f"{ga.dtype}{list(ga.shape[1:])} per row, "
+                        f"task expects {wa.dtype}{list(wa.shape[1:])}"
+                    )
+                if ga.shape[0] != self._rows:
+                    raise ValueError(
+                        f"dataset produced {ga.shape[0]} rows, "
+                        f"expected {self._rows}"
+                    )
+        return raw
+
+    def close(self) -> None:
+        self._it.close()
 
 
 class _BatchPrefetcher:
@@ -445,15 +508,19 @@ class Trainer:
         microbatch dim under gradient accumulation)."""
         return 1 if max(self.config.grad_accum_steps, 1) > 1 else 0
 
-    def _input_shard_plan(self) -> Tuple[int, int, int]:
+    def _input_shard_plan(
+        self, num_shards: Optional[int] = None
+    ) -> Tuple[int, int, int]:
         """Per-host input decomposition: returns ``(shard_lo, shard_hi,
         num_shards)`` — the half-open range of input shards THIS process
         must synthesize, derived from which rows of the sharded batch dim
         its addressable devices actually hold (``devices_indices_map``),
         so the row→process mapping is read off the real sharding rather
-        than assumed."""
+        than assumed. ``num_shards=None`` uses the config (per_host mode);
+        files mode passes the process count explicitly (one file-backed
+        stream per process)."""
         cfg, task = self.config, self.task
-        num_shards = cfg.input_shards or jax.process_count()
+        num_shards = num_shards or cfg.input_shards or jax.process_count()
         accum = max(cfg.grad_accum_steps, 1)
         if task.batch_size % num_shards:
             raise ValueError(
@@ -507,6 +574,67 @@ class Trainer:
                 "shards don't straddle processes"
             )
         return lo // rows_per_shard, hi // rows_per_shard, num_shards
+
+    def _open_input_files(self, start_step: int):
+        """Open the record-shard input stream (input_mode="files"): expand
+        ``config.input_files`` (comma-separated paths/globs), give THIS
+        process its round-robin file share and a local batch sized to its
+        addressable rows, validate the first decoded batch against the
+        task's schema, and fast-forward to ``start_step`` (one batch per
+        step) so checkpoint resume continues the exact record stream.
+        Returns an endless iterator of RAW host batches (prepare_batch is
+        applied by the caller)."""
+        import glob as globlib
+
+        from tfk8s_tpu.data.dataset import RecordDataset
+
+        cfg, task = self.config, self.task
+        paths: List[str] = []
+        for part in (cfg.input_files or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if any(c in part for c in "*?["):
+                hits = sorted(globlib.glob(part))
+                if not hits:
+                    raise ValueError(
+                        f"input_files pattern matched nothing: {part!r}"
+                    )
+                paths.extend(hits)
+            else:
+                paths.append(part)
+        if not paths:
+            raise ValueError(f"input_files is empty: {cfg.input_files!r}")
+
+        nproc = jax.process_count()
+        if nproc > 1:
+            shard_lo, shard_hi, num_shards = self._input_shard_plan(
+                num_shards=nproc
+            )
+            local_rows = (shard_hi - shard_lo) * (task.batch_size // num_shards)
+            self.input_shard_range = (shard_lo, shard_hi, num_shards)
+        else:
+            local_rows = task.batch_size
+        ds = RecordDataset(
+            paths,
+            batch_size=local_rows,
+            host_index=jax.process_index(),
+            num_hosts=nproc,
+            seed=cfg.seed,
+        )
+        log.info(
+            "%s: file input — process %d/%d reads %d files / %d records, "
+            "%d rows/step, resuming at batch %d",
+            task.name, jax.process_index(), nproc, len(ds.files), len(ds),
+            local_rows, start_step,
+        )
+        # prefetch=0: fit's own _BatchPrefetcher supplies the background
+        # thread; a second producer here would double-buffer the batches
+        it = ds.iterator(prefetch=0, start_batch=start_step)
+
+        return _CheckedFileStream(
+            it, self.task.make_batch(np.random.default_rng(0), 1), local_rows
+        )
 
     def _make_shard_batch(self, step: int, shard_lo: int, shard_hi: int,
                           num_shards: int):
@@ -636,12 +764,28 @@ class Trainer:
         stacked_shardings = self.stacked_batch_shardings
 
         input_mode = cfg.input_mode or (
-            "per_host" if jax.process_count() > 1 else "replicated"
+            "files"
+            if cfg.input_files
+            else ("per_host" if jax.process_count() > 1 else "replicated")
         )
-        if input_mode not in ("replicated", "per_host"):
+        if input_mode not in ("replicated", "per_host", "files"):
             raise ValueError(f"unknown input_mode {cfg.input_mode!r}")
-        self._per_host_active = input_mode == "per_host"
-        if self._per_host_active:
+        if input_mode == "files" and not cfg.input_files:
+            raise ValueError('input_mode="files" needs input_files')
+        if cfg.input_files and input_mode != "files":
+            # silently training on synthetic data while the user's record
+            # shards sit unopened would be the worst kind of misconfig
+            raise ValueError(
+                f"input_files is set but input_mode={input_mode!r} would "
+                'ignore it — use input_mode="files" (or unset one)'
+            )
+        # files mode reuses the per-host ASSEMBLY path on multi-process
+        # runs (_put_global short-circuits to device_put single-process)
+        self._per_host_active = input_mode != "replicated"
+        files_iter = None
+        if input_mode == "files":
+            files_iter = self._open_input_files(start_step)
+        elif self._per_host_active:
             shard_lo, shard_hi, num_shards = self._input_shard_plan()
             # surfaced for tests/operators: which input shards THIS
             # process synthesizes (disjoint across the gang)
@@ -667,6 +811,8 @@ class Trainer:
         base_key = jax.random.key(cfg.seed)
 
         def _make_host_batch(step: int):
+            if files_iter is not None:
+                return self.prepare_batch(next(files_iter))
             if self._per_host_active:
                 return self._make_shard_batch(step, shard_lo, shard_hi, num_shards)
             return self.prepare_batch(
@@ -792,6 +938,8 @@ class Trainer:
             # would spin on its bounded queue holding staged device batches)
             if prefetcher is not None:
                 prefetcher.close()
+            if files_iter is not None:
+                files_iter.close()
         if profiling:  # run ended inside the trace window
             jax.profiler.stop_trace()
         if ckpt and ckpt.enabled:
@@ -916,6 +1064,7 @@ def run_task(
                 if env.get("TFK8S_INPUT_SHARDS")
                 else None
             ),
+            input_files=env.get("TFK8S_INPUT_FILES") or None,
         )
 
     trainer = Trainer(task, config, mesh)
