@@ -162,14 +162,42 @@ class TrainConfig:
     # (TFK8S_INPUT_FILES); examples must decode to the task's batch schema
     input_files: Optional[str] = None
 
+    # Learning-rate decay after warmup: "constant" (default), "cosine"
+    # (to min_lr_ratio * learning_rate over decay_steps), or "linear".
+    # decay_steps=None decays over the remaining run (steps - warmup).
+    lr_schedule: str = "constant"
+    decay_steps: Optional[int] = None
+    min_lr_ratio: float = 0.0
+
+    def make_schedule(self):
+        """The scalar step->lr schedule the optimizer runs on (exposed so
+        tests and logging can evaluate it directly)."""
+        peak, warm = self.learning_rate, max(self.warmup_steps, 0)
+        decay = self.decay_steps or max(self.steps - warm, 1)
+        floor = peak * self.min_lr_ratio
+        if self.lr_schedule == "constant":
+            main = optax.constant_schedule(peak)
+        elif self.lr_schedule == "cosine":
+            main = optax.cosine_decay_schedule(
+                peak, decay, alpha=self.min_lr_ratio
+            )
+        elif self.lr_schedule == "linear":
+            main = optax.linear_schedule(peak, floor, decay)
+        else:
+            raise ValueError(
+                f"unknown lr_schedule {self.lr_schedule!r} "
+                "(constant | cosine | linear)"
+            )
+        if warm > 0:
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, warm), main], [warm]
+            )
+        return main
+
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer is not None:
             return self.optimizer
-        if self.warmup_steps > 0:
-            sched = optax.linear_schedule(0.0, self.learning_rate, self.warmup_steps)
-        else:
-            sched = self.learning_rate
-        return optax.adamw(sched, weight_decay=self.weight_decay)
+        return optax.adamw(self.make_schedule(), weight_decay=self.weight_decay)
 
 
 def _suffix_match_shardings(abstract_tree, params_paths, mesh):
@@ -1065,6 +1093,14 @@ def run_task(
                 else None
             ),
             input_files=env.get("TFK8S_INPUT_FILES") or None,
+            warmup_steps=int(env.get("TFK8S_WARMUP_STEPS", "0")),
+            lr_schedule=env.get("TFK8S_LR_SCHEDULE", "constant"),
+            decay_steps=(
+                int(env["TFK8S_DECAY_STEPS"])
+                if env.get("TFK8S_DECAY_STEPS")
+                else None
+            ),
+            min_lr_ratio=float(env.get("TFK8S_MIN_LR_RATIO", "0.0")),
         )
 
     trainer = Trainer(task, config, mesh)
