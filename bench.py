@@ -306,6 +306,81 @@ def _gpt_decode_ms_per_token(small: bool):
     )
 
 
+def _recordio_probe(small: bool):
+    """Input-pipeline throughput on THIS host: write a shard of
+    float32-array examples, then measure (a) the native C++ reader's
+    CRC-verified bulk read and (b) the pure-Python fallback on a smaller
+    slice (its byte-at-a-time CRC is ~1000x slower — measuring the full
+    shard would dominate the bench), plus the full read+decode+stack
+    dataset path. Host-side only — no accelerator involvement. Returns a
+    dict or None when the native lib is unavailable (the comparison is
+    the point)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from tfk8s_tpu.data import RecordDataset, RecordFile, RecordWriter, encode
+    from tfk8s_tpu.data import _native
+
+    if _native.load() is None:
+        return None
+    n_rec, leaf = (64, 4096) if small else (512, 32768)  # ~1 MB / ~64 MB
+    rng = np.random.default_rng(0)
+    d = tempfile.mkdtemp(prefix="bench-recordio-")
+    try:
+        path = os.path.join(d, "shard.rio")
+        payload = [
+            encode({"x": rng.standard_normal(leaf).astype(np.float32)})
+            for _ in range(min(n_rec, 32))
+        ]
+        t0 = time.perf_counter()
+        with RecordWriter(path) as w:
+            for i in range(n_rec):
+                w.write(payload[i % len(payload)])
+        write_s = time.perf_counter() - t0
+        nbytes = os.path.getsize(path)
+
+        rf = RecordFile(path)
+        idx = list(range(len(rf)))
+
+        def read_all():
+            rf.read(idx, verify=True)
+
+        read_all()  # page cache warm
+        native_s, _ = _median_window(read_all)
+
+        # pure-python fallback on a 1/16 slice, rate scaled from its bytes
+        py_slice = idx[: max(len(idx) // 16, 1)]
+        py_bytes = sum(rf.lengths[i] for i in py_slice)
+        try:
+            _native._tried, _native._lib, saved = True, None, _native._lib
+            py_rf = RecordFile(path)
+            t0 = time.perf_counter()
+            py_rf.read(py_slice, verify=True)
+            py_s = time.perf_counter() - t0
+        finally:
+            _native._lib, _native._tried = saved, True
+
+        ds = RecordDataset([path], batch_size=min(32, n_rec), seed=0)
+        it = iter(ds.batches(0))
+        t0 = time.perf_counter()
+        n_batches = sum(1 for _ in it)
+        ds_s = time.perf_counter() - t0
+        native_rate, py_rate = nbytes / native_s, py_bytes / py_s
+        return {
+            "recordio_shard_mb": round(nbytes / 1e6, 1),
+            "recordio_write_mbps": round(nbytes / write_s / 1e6, 1),
+            "recordio_native_read_mbps": round(native_rate / 1e6, 1),
+            "recordio_python_read_mbps": round(py_rate / 1e6, 1),
+            "recordio_native_speedup": round(native_rate / py_rate, 1),
+            "recordio_pipeline_mbps": round(nbytes / ds_s / 1e6, 1),
+            "recordio_pipeline_batches_per_s": round(n_batches / ds_s, 1),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _PROBE_CODE = """
 import os
 if os.environ.get("BENCH_PLATFORM"):
@@ -506,6 +581,15 @@ def main() -> None:
             print(f"bench: gpt decode row failed: {exc}", file=sys.stderr)
             degraded.append("gpt_decode")
 
+    # -- input pipeline: native record-reader throughput (host-side) -----
+    recordio_block = None
+    if os.environ.get("BENCH_RECORDIO", "1") == "1":
+        try:
+            recordio_block = _recordio_probe(small)
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: recordio probe failed: {exc}", file=sys.stderr)
+            degraded.append("recordio")
+
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     baseline_note = {}
@@ -639,6 +723,7 @@ def main() -> None:
                         ),
                     },
                     **({"roofline": roofline_block} if roofline_block else {}),
+                    **({"recordio": recordio_block} if recordio_block else {}),
                     **(
                         {
                             "flash_attn_ms_seq2048": round(flash_ms, 3),

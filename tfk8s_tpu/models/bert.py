@@ -69,7 +69,7 @@ class BertWithHead(nn.Module):
             )
             for i in range(self.cfg.num_layers)
         ]
-        self.ln_final = _ln("ln_final")
+        self.ln_final = _ln("ln_final", self.cfg.ln_eps)
 
     def __call__(
         self,

@@ -292,6 +292,105 @@ def greedy_generate(
     return generate(cfg, params, prompt, num_tokens)
 
 
+def load_hf_gpt2(hf_model) -> Tuple[TransformerConfig, Any]:
+    """Import a Hugging Face ``GPT2LMHeadModel`` (torch) into this
+    framework's ``(cfg, params)``.
+
+    The stacks are topologically identical — pre-LN blocks (ln_1 → attn →
+    residual, ln_2 → mlp → residual), learned absolute positions, final
+    LN, tied lm_head — so the mapping is a pure relabel/reshape:
+
+    - ``wte``/``wpe``            → ``embed.tok.embedding`` / ``embed.pos``
+    - ``h.i.ln_1``/``ln_2``      → ``layer{i}.ln_attn`` / ``ln_mlp``
+    - ``h.i.attn.c_attn`` (fused qkv, Conv1D [in, 3*out])
+                                 → ``attn.{q,k,v}`` kernels [embed, h, d]
+    - ``h.i.attn.c_proj``        → ``attn.out`` kernel [h, d, embed]
+    - ``h.i.mlp.c_fc``/``c_proj``→ ``mlp.wi`` / ``mlp.wo``
+    - ``ln_f``                   → ``ln_final``
+
+    HF's Conv1D already stores kernels [in, out] (no transpose needed);
+    activations here run the same tanh-approx gelu HF calls gelu_new,
+    and ``ln_eps`` is set to the checkpoint's layer_norm_epsilon.
+    Numerical agreement with the torch forward is asserted in
+    tests/test_gpt.py::test_hf_gpt2_import_matches_torch_logits."""
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    hc = hf_model.config
+    if hc.n_embd % hc.n_head:
+        raise ValueError(f"n_embd {hc.n_embd} not divisible by n_head {hc.n_head}")
+    # refuse configs whose FORWARD differs from this stack — importing
+    # them would complete and then silently produce wrong logits
+    act = getattr(hc, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"activation_function={act!r} unsupported — this stack runs "
+            "tanh-approx gelu (gelu_new); erf-gelu/relu checkpoints would "
+            "import cleanly but decode wrong"
+        )
+    if not getattr(hc, "scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False is unsupported")
+    if getattr(hc, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx is unsupported")
+    if getattr(hc, "reorder_and_upcast_attn", False):
+        raise ValueError("reorder_and_upcast_attn is unsupported")
+    head_dim = hc.n_embd // hc.n_head
+    cfg = TransformerConfig(
+        vocab_size=hc.vocab_size,
+        embed_dim=hc.n_embd,
+        num_heads=hc.n_head,
+        head_dim=head_dim,
+        mlp_dim=getattr(hc, "n_inner", None) or 4 * hc.n_embd,
+        num_layers=hc.n_layer,
+        max_len=hc.n_positions,
+        ln_eps=float(hc.layer_norm_epsilon),
+        dtype=jnp.float32,  # import at full precision; caller may cast
+    )
+    f32 = lambda a: jnp.asarray(a, jnp.float32)
+    e, h, d = cfg.embed_dim, cfg.num_heads, head_dim
+
+    def ln(prefix):
+        return {"scale": f32(sd[f"{prefix}.weight"]),
+                "bias": f32(sd[f"{prefix}.bias"])}
+
+    params = {
+        "embed": {
+            "tok": {"embedding": f32(sd["transformer.wte.weight"])},
+            "pos": f32(sd["transformer.wpe.weight"]),
+        },
+        "ln_final": ln("transformer.ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}"
+        qkv_w = sd[f"{p}.attn.c_attn.weight"]  # [e, 3e], Conv1D = [in, out]
+        qkv_b = sd[f"{p}.attn.c_attn.bias"]  # [3e]
+        wq, wk, wv = np.split(qkv_w, 3, axis=1)
+        bq, bk, bv = np.split(qkv_b, 3)
+        params[f"layer{i}"] = {
+            "ln_attn": ln(f"{p}.ln_1"),
+            "ln_mlp": ln(f"{p}.ln_2"),
+            "attn": {
+                "q": {"kernel": f32(wq.reshape(e, h, d)),
+                      "bias": f32(bq.reshape(h, d))},
+                "k": {"kernel": f32(wk.reshape(e, h, d)),
+                      "bias": f32(bk.reshape(h, d))},
+                "v": {"kernel": f32(wv.reshape(e, h, d)),
+                      "bias": f32(bv.reshape(h, d))},
+                "out": {
+                    "kernel": f32(
+                        sd[f"{p}.attn.c_proj.weight"].reshape(h, d, e)
+                    ),
+                    "bias": f32(sd[f"{p}.attn.c_proj.bias"]),
+                },
+            },
+            "mlp": {
+                "wi": {"kernel": f32(sd[f"{p}.mlp.c_fc.weight"]),
+                       "bias": f32(sd[f"{p}.mlp.c_fc.bias"])},
+                "wo": {"kernel": f32(sd[f"{p}.mlp.c_proj.weight"]),
+                       "bias": f32(sd[f"{p}.mlp.c_proj.bias"])},
+            },
+        }
+    return cfg, params
+
+
 def task_for_mesh(
     mesh,
     cfg: Optional[TransformerConfig] = None,

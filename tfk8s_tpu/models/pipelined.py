@@ -135,7 +135,7 @@ def make_task(
 
     stage_cfg = _dc.replace(cfg, partition_params=False)
     stage = PipelineStage(stage_cfg, layers_per_stage)
-    ln_final = _ln("ln_final")
+    ln_final = _ln("ln_final", cfg.ln_eps)
 
     def init(rng):
         r_embed, r_stage, r_ln = jax.random.split(rng, 3)

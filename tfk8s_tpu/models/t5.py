@@ -69,8 +69,8 @@ class T5(nn.Module):
             dec_layer(cfg, attn_fn=self.attn_fn, name=f"dec{i}")
             for i in range(cfg.num_layers)
         ]
-        self.enc_ln = _ln("enc_ln")
-        self.dec_ln = _ln("dec_ln")
+        self.enc_ln = _ln("enc_ln", self.cfg.ln_eps)
+        self.dec_ln = _ln("dec_ln", self.cfg.ln_eps)
 
     def encode(self, src: jax.Array) -> Tuple[jax.Array, jax.Array]:
         mask = src != PAD_ID

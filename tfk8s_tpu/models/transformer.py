@@ -71,6 +71,10 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
+    # LayerNorm epsilon. The flax default (1e-6) is kept for this repo's
+    # own checkpoints; HF GPT-2 weights are trained against 1e-5 and the
+    # importer (models/gpt.load_hf_gpt2) sets it to match.
+    ln_eps: float = 1e-6
     # KV-cache buffer length for incremental decode (None = max_len).
     # Right-size it to the REQUEST (prompt + generation): the per-step
     # cache update/attention traffic scales with the BUFFER length, not
@@ -239,9 +243,10 @@ class MlpBlock(nn.Module):
                       partition=cfg.partition_params)(h)
 
 
-def _ln(name: str) -> nn.LayerNorm:
+def _ln(name: str, eps: float = 1e-6) -> nn.LayerNorm:
     return nn.LayerNorm(
-        dtype=jnp.float32, param_dtype=jnp.float32, use_bias=True, name=name
+        epsilon=eps, dtype=jnp.float32, param_dtype=jnp.float32,
+        use_bias=True, name=name,
     )
 
 
@@ -263,12 +268,12 @@ class EncoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
-        h = _ln("ln_attn")(x).astype(cfg.dtype)
+        h = _ln("ln_attn", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
             cfg, causal=self.causal, attn_fn=self.attn_fn,
             decode=self.decode, name="attn"
         )(h, mask=mask)
-        h = _ln("ln_mlp")(x).astype(cfg.dtype)
+        h = _ln("ln_mlp", cfg.ln_eps)(x).astype(cfg.dtype)
         if self.use_moe:
             from tfk8s_tpu.parallel.moe import SwitchMoeBlock
 
@@ -305,15 +310,15 @@ class DecoderLayer(nn.Module):
         enc_mask: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.cfg
-        h = _ln("ln_self")(x).astype(cfg.dtype)
+        h = _ln("ln_self", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
             cfg, causal=True, attn_fn=self.attn_fn, name="self_attn"
         )(h)
-        h = _ln("ln_cross")(x).astype(cfg.dtype)
+        h = _ln("ln_cross", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(cfg, attn_fn=self.attn_fn, name="cross_attn")(
             h, kv=enc, mask=enc_mask
         )
-        h = _ln("ln_mlp")(x).astype(cfg.dtype)
+        h = _ln("ln_mlp", cfg.ln_eps)(x).astype(cfg.dtype)
         out = x + MlpBlock(cfg, name="mlp")(h)
         if cfg.partition_params:
             out = act_constraint(out, "batch", "seq", "embed")
@@ -462,4 +467,4 @@ class Encoder(nn.Module):
                 use_moe=cfg.layer_uses_moe(i),
                 name=f"layer{i}",
             )(x, mask)
-        return _ln("ln_final")(x).astype(cfg.dtype)
+        return _ln("ln_final", cfg.ln_eps)(x).astype(cfg.dtype)
