@@ -441,3 +441,41 @@ def test_hf_gpt2_import_matches_torch_logits():
             nxt = hf(t_ids).logits[:, -1].argmax(-1, keepdim=True)
             t_ids = torch.cat([t_ids, nxt], dim=1)
     np.testing.assert_array_equal(np.asarray(gen), t_ids[:, 8:].numpy())
+
+
+def test_hf_gpt2_export_roundtrip():
+    """save_hf_gpt2 is the exact inverse of load_hf_gpt2: a framework
+    model trained here exports to a torch GPT2LMHeadModel whose logits
+    match ours, and re-importing reproduces identical params."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+
+    cfg = gpt.tiny_config(
+        dtype=jnp.float32, embed_dim=32, num_heads=4, head_dim=8,
+        mlp_dim=80, max_len=48, ln_eps=1e-5,
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    params = unbox(gpt.GPTLM(cfg).init(jax.random.key(3), ids)["params"])
+    ours = np.asarray(gpt.GPTLM(cfg).apply({"params": params}, ids))
+
+    hf = gpt.save_hf_gpt2(cfg, params)
+    with torch.no_grad():
+        theirs = hf(torch.asarray(np.array(ids, copy=True))).logits.numpy()
+    np.testing.assert_allclose(theirs, ours, atol=2e-4, rtol=1e-4)
+
+    cfg2, params2 = gpt.load_hf_gpt2(hf)
+    assert (cfg2.mlp_dim, cfg2.ln_eps) == (80, pytest.approx(1e-5))
+    keystr = jax.tree_util.keystr
+    by_path = lambda kv: keystr(kv[0])
+    ours_leaves = sorted(
+        jax.tree_util.tree_leaves_with_path(params), key=by_path
+    )
+    reimported = sorted(
+        jax.tree_util.tree_leaves_with_path(params2), key=by_path
+    )
+    assert len(ours_leaves) == len(reimported)
+    for (ka, a), (kb, b) in zip(ours_leaves, reimported):
+        assert keystr(ka) == keystr(kb)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

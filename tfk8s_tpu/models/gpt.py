@@ -391,6 +391,83 @@ def load_hf_gpt2(hf_model) -> Tuple[TransformerConfig, Any]:
     return cfg, params
 
 
+def save_hf_gpt2(cfg: TransformerConfig, params) -> "Any":
+    """Export this framework's ``(cfg, params)`` to a Hugging Face
+    ``GPT2LMHeadModel`` — the inverse of ``load_hf_gpt2`` (same pure
+    relabel/reshape, run backwards), so a model trained here can be
+    served by any HF-compatible stack. Round-trip equality is asserted
+    in tests/test_gpt.py::test_hf_gpt2_export_roundtrip."""
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    params = jax.tree_util.tree_map(np.asarray, unbox(params))
+    e, h, d = cfg.embed_dim, cfg.num_heads, cfg.head_dim
+    if e != h * d:
+        raise ValueError(
+            f"HF GPT-2 requires embed_dim == num_heads*head_dim; got "
+            f"{e} != {h}*{d}"
+        )
+    if cfg.num_experts > 0:
+        raise ValueError(
+            "MoE models have no GPT-2 equivalent — dense-distill or "
+            "export per-expert weights yourself"
+        )
+    hf = GPT2LMHeadModel(
+        GPT2Config(
+            vocab_size=cfg.vocab_size, n_positions=cfg.max_len, n_embd=e,
+            n_layer=cfg.num_layers, n_head=h, n_inner=cfg.mlp_dim,
+            layer_norm_epsilon=cfg.ln_eps, activation_function="gelu_new",
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+    )
+    # copy=True: jax-backed numpy views are read-only and torch warns on
+    # (and forbids mutating) non-writable storage
+    t = lambda a: torch.asarray(np.array(a, np.float32, copy=True))
+    sd = {
+        "transformer.wte.weight": t(params["embed"]["tok"]["embedding"]),
+        "transformer.wpe.weight": t(params["embed"]["pos"]),
+        "transformer.ln_f.weight": t(params["ln_final"]["scale"]),
+        "transformer.ln_f.bias": t(params["ln_final"]["bias"]),
+        "lm_head.weight": t(params["embed"]["tok"]["embedding"]),  # tied
+    }
+    for i in range(cfg.num_layers):
+        lp, p = params[f"layer{i}"], f"transformer.h.{i}"
+        at = lp["attn"]
+        sd[f"{p}.ln_1.weight"] = t(lp["ln_attn"]["scale"])
+        sd[f"{p}.ln_1.bias"] = t(lp["ln_attn"]["bias"])
+        sd[f"{p}.ln_2.weight"] = t(lp["ln_mlp"]["scale"])
+        sd[f"{p}.ln_2.bias"] = t(lp["ln_mlp"]["bias"])
+        sd[f"{p}.attn.c_attn.weight"] = t(
+            np.concatenate(
+                [at[k]["kernel"].reshape(e, e) for k in ("q", "k", "v")],
+                axis=1,
+            )
+        )
+        sd[f"{p}.attn.c_attn.bias"] = t(
+            np.concatenate([at[k]["bias"].reshape(e) for k in ("q", "k", "v")])
+        )
+        sd[f"{p}.attn.c_proj.weight"] = t(at["out"]["kernel"].reshape(e, e))
+        sd[f"{p}.attn.c_proj.bias"] = t(at["out"]["bias"])
+        sd[f"{p}.mlp.c_fc.weight"] = t(lp["mlp"]["wi"]["kernel"])
+        sd[f"{p}.mlp.c_fc.bias"] = t(lp["mlp"]["wi"]["bias"])
+        sd[f"{p}.mlp.c_proj.weight"] = t(lp["mlp"]["wo"]["kernel"])
+        sd[f"{p}.mlp.c_proj.bias"] = t(lp["mlp"]["wo"]["bias"])
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # attn.bias / attn.masked_bias are derived causal-mask buffers HF
+    # regenerates; anything else missing is a mapping bug
+    real_missing = [
+        m for m in missing if not m.endswith((".attn.bias", ".attn.masked_bias"))
+    ]
+    if real_missing or unexpected:
+        raise ValueError(
+            f"state_dict mismatch: missing={real_missing} "
+            f"unexpected={list(unexpected)}"
+        )
+    return hf.eval()
+
+
 def task_for_mesh(
     mesh,
     cfg: Optional[TransformerConfig] = None,
