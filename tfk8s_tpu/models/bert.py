@@ -54,6 +54,9 @@ class BertWithHead(nn.Module):
     # incremental KV-cache generation (transformer.MultiHeadAttention
     # decode path); only meaningful with causal=True
     decode: bool = False
+    # sow per-layer K/V into "kv_cache" during a full forward — batched
+    # prefill support (models/gpt.prefill_cache)
+    sow_kv: bool = False
 
     def setup(self):
         self.embed = Embedder(self.cfg, name="embed")
@@ -65,6 +68,7 @@ class BertWithHead(nn.Module):
                 use_moe=self.cfg.layer_uses_moe(i),
                 causal=self.causal,
                 decode=self.decode,
+                sow_kv=self.sow_kv,
                 name=f"layer{i}",
             )
             for i in range(self.cfg.num_layers)

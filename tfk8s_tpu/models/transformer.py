@@ -128,6 +128,11 @@ class MultiHeadAttention(nn.Module):
     causal: bool = False
     attn_fn: Optional[Callable] = None
     decode: bool = False
+    # sow this call's raw K/V projections into the "kv_cache" collection —
+    # batched prefill (models/gpt.prefill_cache) runs ONE full forward
+    # over the prompt and seeds the decode cache from the sown values
+    # instead of paying prompt_len single-token steps
+    sow_kv: bool = False
 
     @nn.compact
     def __call__(
@@ -143,6 +148,10 @@ class MultiHeadAttention(nn.Module):
         k = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "k", cfg.dtype, partition=part)(kv)
         v = _dense((cfg.num_heads, cfg.head_dim), ("embed", "heads", "kv"), "v", cfg.dtype, partition=part)(kv)
         q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
+        if self.sow_kv:
+            # names must not collide with the q/k/v/out submodule scopes
+            self.sow("kv_cache", "prefill_k", k)
+            self.sow("kv_cache", "prefill_v", v)
 
         if self.decode:
             b, step_len, h, d = k.shape
@@ -264,6 +273,7 @@ class EncoderLayer(nn.Module):
     use_moe: bool = False
     causal: bool = False
     decode: bool = False
+    sow_kv: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
@@ -271,7 +281,7 @@ class EncoderLayer(nn.Module):
         h = _ln("ln_attn", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
             cfg, causal=self.causal, attn_fn=self.attn_fn,
-            decode=self.decode, name="attn"
+            decode=self.decode, sow_kv=self.sow_kv, name="attn"
         )(h, mask=mask)
         h = _ln("ln_mlp", cfg.ln_eps)(x).astype(cfg.dtype)
         if self.use_moe:
