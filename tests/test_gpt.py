@@ -597,3 +597,65 @@ def test_prefill_cache_seeds_exact_decode_state():
             np.asarray(a), np.asarray(b), atol=1e-5,
             err_msg=jax.tree_util.keystr(pa),
         )
+
+
+def test_generate_eos_stop_semantics():
+    """eos_id: the stop token is emitted, everything after is pad, rows
+    stop independently, and an eos that never fires reproduces the plain
+    path exactly (while_loop vs scan)."""
+    cfg = gpt.tiny_config(max_len=64, dtype=jnp.float32)
+    prompt = jnp.asarray(
+        np.random.default_rng(12).integers(1, cfg.vocab_size, (4, 8)), jnp.int32
+    )
+    params = gpt.GPTLM(cfg).init(jax.random.key(0), prompt)["params"]
+
+    # an eos that cannot fire (greedy chain outputs are untrained/random
+    # but deterministic; pick a token the run does not produce)
+    plain = np.asarray(gpt.generate(cfg, params, prompt, num_tokens=10))
+    unused = next(t for t in range(cfg.vocab_size)
+                  if t not in set(plain.ravel().tolist()))
+    same = np.asarray(
+        gpt.generate(cfg, params, prompt, num_tokens=10, eos_id=unused)
+    )
+    np.testing.assert_array_equal(plain, same)
+
+    # force a fast stop: an eos the greedy decode emits early in some row
+    vals, counts = np.unique(plain, return_counts=True)
+    eos = int(vals[np.argmax(counts)])  # the most common generated token
+    stopped = np.asarray(
+        gpt.generate(cfg, params, prompt, num_tokens=10, eos_id=eos,
+                     pad_id=0)
+    )
+    for r in range(stopped.shape[0]):
+        row = stopped[r]
+        hits = np.nonzero(row == eos)[0]
+        if hits.size:
+            first = hits[0]
+            assert (row[first + 1:] == 0).all(), row  # pad after eos
+            # the prefix before eos matches the unstopped generation
+            np.testing.assert_array_equal(row[:first + 1],
+                                          plain[r][:first + 1])
+        else:
+            np.testing.assert_array_equal(row, plain[r])
+
+
+def test_generate_eos_under_jit_and_sampling():
+    """The while_loop path jits (data-dependent TRIP COUNT, static
+    shapes) and composes with sampling."""
+    cfg = gpt.tiny_config(max_len=48, dtype=jnp.float32)
+    prompt = jnp.asarray(
+        np.random.default_rng(13).integers(1, cfg.vocab_size, (2, 6)), jnp.int32
+    )
+    params = gpt.GPTLM(cfg).init(jax.random.key(0), prompt)["params"]
+    run = jax.jit(
+        lambda p, pr: gpt.generate(
+            cfg, p, pr, num_tokens=8, rng=jax.random.key(3),
+            temperature=1.0, top_k=8, eos_id=5, pad_id=0,
+        )
+    )
+    out = np.asarray(run(params, prompt))
+    assert out.shape == (2, 8)
+    for row in out:
+        hits = np.nonzero(row == 5)[0]
+        if hits.size:
+            assert (row[hits[0] + 1:] == 0).all(), row

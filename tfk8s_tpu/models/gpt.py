@@ -250,6 +250,8 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     batched_prefill: bool = True,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
 ) -> jax.Array:
     """Jit-compatible KV-cache decoding — greedy or sampled. Default
     (``batched_prefill=True``): ONE full causal forward processes the
@@ -265,6 +267,13 @@ def generate(
     tokens are drawn from ``softmax(filter_logits(logits / temperature,
     top_k, top_p))`` with a key folded from ``rng`` by ABSOLUTE step
     index — the sampled stream does not depend on which prefill path ran.
+
+    ``eos_id`` enables stop-token semantics (requires
+    ``batched_prefill``): a row's EOS is emitted, every later position is
+    ``pad_id``, and the decode runs as a ``lax.while_loop`` that EXITS
+    EARLY on device once EVERY row has finished — the batch costs its
+    LONGEST completion instead of always paying ``num_tokens`` (output
+    stays a static ``[b, num_tokens]``, pad-filled).
 
     The per-layer K/V buffers are ``[b, cache_len, h, d]`` with
     cache_len RIGHT-SIZED to this request (prompt + generation) — the
@@ -314,28 +323,62 @@ def generate(
             ).astype(prompt.dtype)
         return jnp.argmax(step_logits, axis=-1).astype(prompt.dtype)
 
+    if eos_id is not None and not batched_prefill:
+        raise ValueError("eos_id requires batched_prefill=True")
+
     if batched_prefill:
         # ONE full forward processes the prompt (prompt-parallel matmuls)
         prompt_logits, cache = prefill_cache(cfg, params, prompt)
         tok0 = pick(prompt_logits[:, -1].astype(jnp.float32), prompt_len - 1)
 
-        def dstep(carry, j):
-            cache, tok = carry
+        def decode_one(cache, tok, j):
             logits, mut = decoder.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
                 pos_offset=prompt_len + j,
                 mutable=["cache"],
             )
-            nxt = pick(logits[:, 0].astype(jnp.float32), prompt_len + j)
-            return (mut["cache"], nxt), nxt
+            return mut["cache"], pick(
+                logits[:, 0].astype(jnp.float32), prompt_len + j
+            )
 
-        (_, _), rest = jax.lax.scan(
-            dstep, (cache, tok0), jnp.arange(num_tokens - 1)
+        if eos_id is None:
+            def dstep(carry, j):
+                cache, tok = carry
+                cache, nxt = decode_one(cache, tok, j)
+                return (cache, nxt), nxt
+
+            (_, _), rest = jax.lax.scan(
+                dstep, (cache, tok0), jnp.arange(num_tokens - 1)
+            )
+            return jnp.concatenate(
+                [tok0[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+            )
+
+        # EOS path: while_loop with on-device early exit when every row
+        # has emitted its stop token
+        pad = jnp.asarray(pad_id, prompt.dtype)
+        out = jnp.full((b, num_tokens), pad).at[:, 0].set(tok0)
+        done0 = tok0 == eos_id
+
+        def cond(st):
+            j, _cache, _tok, _out, done = st
+            return (j < num_tokens - 1) & ~jnp.all(done)
+
+        def body(st):
+            j, cache, tok, out, done = st
+            # finished rows keep feeding pad — their cache rows are dead
+            cache, nxt = decode_one(cache, jnp.where(done, pad, tok), j)
+            emitted = jnp.where(done, pad, nxt)
+            out = jax.lax.dynamic_update_slice(
+                out, emitted[:, None], (0, j + 1)
+            )
+            return j + 1, cache, emitted, out, done | (emitted == eos_id)
+
+        _j, _cache, _tok, out, _done = jax.lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), cache, tok0, out, done0)
         )
-        return jnp.concatenate(
-            [tok0[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
-        )
+        return out
 
     cache = init_cache(cfg, b)
     # prompt extended with a zero tail so the scan can index one stream
