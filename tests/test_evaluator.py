@@ -212,3 +212,52 @@ def test_evaluator_failure_does_not_kill_the_gang(tmp_path):
     finally:
         stop.set()
         ctrl.controller.shutdown()
+
+
+def test_run_eval_from_record_shards(tmp_path):
+    """TFK8S_EVAL_INPUT_FILES: the evaluator reads its held-out set from
+    record shards (deterministic unshuffled order — every checkpoint is
+    scored on the SAME batches), and two evals of the same checkpoint
+    report identical metrics."""
+    import numpy as np
+
+    from tfk8s_tpu.data import RecordWriter, encode
+    from tfk8s_tpu.models import gpt
+    from tfk8s_tpu.models.bert import make_chain_tokens
+    from tfk8s_tpu.parallel.mesh import make_mesh
+
+    cfg = gpt.tiny_config()
+    task = gpt.make_task(cfg=cfg, seq_len=32, batch_size=16)
+    rng = np.random.default_rng(3)
+    eval_path = str(tmp_path / "heldout.rio")
+    with RecordWriter(eval_path) as w:
+        for _ in range(48):
+            toks = make_chain_tokens(rng, 1, 32, cfg.vocab_size)[0]
+            w.write(encode({"input": toks.astype(np.int32)}))
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    mesh = make_mesh(data=8)
+    Trainer(
+        task,
+        TrainConfig(steps=40, learning_rate=3e-3, checkpoint_every=40,
+                    checkpoint_dir=ckpt_dir),
+        mesh,
+    ).fit()
+
+    env = {
+        "TFK8S_CHECKPOINT_DIR": ckpt_dir,
+        "TFK8S_TRAIN_STEPS": "40",
+        "TFK8S_EVAL_TIMEOUT": "60",
+        "TFK8S_EVAL_BATCHES": "8",  # > 3 available -> clamped
+        "TFK8S_EVAL_INPUT_FILES": eval_path,
+        "TFK8S_MESH": '{"data": 8}',
+    }
+    m1 = run_eval(task, env=dict(env), mesh=mesh)
+    m2 = run_eval(task, env=dict(env), mesh=mesh)
+    assert m1["step"] == 40.0
+    assert m1["loss"] == m2["loss"], (m1, m2)  # same batches, same score
+
+    bad = dict(env)
+    bad["TFK8S_EVAL_INPUT_FILES"] = str(tmp_path / "absent-*.rio")
+    with pytest.raises(ValueError, match="matched nothing"):
+        run_eval(task, env=bad, mesh=mesh)

@@ -216,6 +216,29 @@ def _suffix_match_shardings(abstract_tree, params_paths, mesh):
     return jax.tree_util.tree_map_with_path(one, abstract_tree)
 
 
+def _expand_input_files(spec: str) -> List[str]:
+    """Expand a comma-separated list of record-file paths/globs (the
+    TFK8S_INPUT_FILES / TFK8S_EVAL_INPUT_FILES value) into a concrete
+    path list; a glob matching nothing fails loudly."""
+    import glob as globlib
+
+    paths: List[str] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if any(c in part for c in "*?["):
+            hits = sorted(globlib.glob(part))
+            if not hits:
+                raise ValueError(f"input pattern matched nothing: {part!r}")
+            paths.extend(hits)
+        else:
+            paths.append(part)
+    if not paths:
+        raise ValueError(f"input file spec is empty: {spec!r}")
+    return paths
+
+
 class _CheckedFileStream:
     """Iterator adapter over a RecordDataset iterator that validates the
     FIRST decoded batch against the task's batch schema (structure,
@@ -612,28 +635,10 @@ class Trainer:
         step) so checkpoint resume continues the exact record stream.
         Returns an endless iterator of RAW host batches (prepare_batch is
         applied by the caller)."""
-        import glob as globlib
-
         from tfk8s_tpu.data.dataset import RecordDataset
 
         cfg, task = self.config, self.task
-        paths: List[str] = []
-        for part in (cfg.input_files or "").split(","):
-            part = part.strip()
-            if not part:
-                continue
-            if any(c in part for c in "*?["):
-                hits = sorted(globlib.glob(part))
-                if not hits:
-                    raise ValueError(
-                        f"input_files pattern matched nothing: {part!r}"
-                    )
-                paths.extend(hits)
-            else:
-                paths.append(part)
-        if not paths:
-            raise ValueError(f"input_files is empty: {cfg.input_files!r}")
-
+        paths = _expand_input_files(cfg.input_files or "")
         nproc = jax.process_count()
         if nproc > 1:
             shard_lo, shard_hi, num_shards = self._input_shard_plan(
@@ -1012,6 +1017,28 @@ def run_eval(
     state = trainer.abstract_state()
     eval_fn = jax.jit(task.loss_fn)
     np_rng = np.random.default_rng(10_000)  # held-out stream
+    # held-out RECORD SHARDS (TFK8S_EVAL_INPUT_FILES): the evaluator reads
+    # its eval set from disk through the same data plane training uses —
+    # deterministic unshuffled order, every restore evaluates the SAME
+    # batches (comparable metrics across checkpoints). Falls back to the
+    # synthetic held-out stream when unset.
+    eval_files = env.get("TFK8S_EVAL_INPUT_FILES")
+    eval_iter = None
+    if eval_files:
+        from tfk8s_tpu.data.dataset import RecordDataset
+
+        eval_ds = RecordDataset(
+            _expand_input_files(eval_files),
+            batch_size=task.batch_size,
+            shuffle=False,
+        )
+        avail = eval_ds.batches_per_epoch()
+        if avail < eval_batches:
+            log.info(
+                "%s-eval: eval set holds %d batches; clamping "
+                "TFK8S_EVAL_BATCHES from %d", task.name, avail, eval_batches,
+            )
+            eval_batches = avail
     ckpt = Checkpointer(ctx.checkpoint_dir)
 
     last_seen = -1
@@ -1027,12 +1054,24 @@ def run_eval(
             step = ckpt.latest_step()
             if step is not None and step > last_seen:
                 state = ckpt.restore(state, step=step)
+                if eval_files:
+                    # fresh iterator per checkpoint: identical batches
+                    # every evaluation (epoch 0, unshuffled); the schema
+                    # check gives records/task mismatches the same loud
+                    # error as the training file path
+                    eval_iter = _CheckedFileStream(
+                        eval_ds.batches(0),
+                        task.make_batch(np.random.default_rng(0), 1),
+                        task.batch_size,
+                    )
                 sums: Dict[str, float] = {}
                 for _ in range(eval_batches):
-                    batch = jax.device_put(
-                        task.make_batch(np_rng, task.batch_size),
-                        trainer.batch_shardings,
+                    host = (
+                        next(eval_iter)
+                        if eval_iter is not None
+                        else task.make_batch(np_rng, task.batch_size)
                     )
+                    batch = jax.device_put(host, trainer.batch_shardings)
                     loss, aux = eval_fn(state.params, batch, jax.random.key(0))
                     for k, v in {"loss": loss, **aux}.items():
                         sums[k] = sums.get(k, 0.0) + float(v)
