@@ -46,10 +46,15 @@ BOS_ID = 1
 
 
 class T5(nn.Module):
-    """Encoder-decoder with a shared embedding table and tied head."""
+    """Encoder-decoder with a shared embedding table and tied head.
+    ``decode=True`` builds the decoder layers in incremental KV-cache
+    mode (one target token per ``decode`` call, ``pos_offset`` carrying
+    the absolute position) — the serving path behind
+    ``greedy_generate``."""
 
     cfg: TransformerConfig
     attn_fn: Optional[Any] = None  # e.g. ops.flash_attention (mask-capable)
+    decode_mode: bool = False
 
     def setup(self):
         cfg = self.cfg
@@ -66,7 +71,10 @@ class T5(nn.Module):
             for i in range(cfg.num_layers)
         ]
         self.dec_layers = [
-            dec_layer(cfg, attn_fn=self.attn_fn, name=f"dec{i}")
+            dec_layer(
+                cfg, attn_fn=self.attn_fn, decode=self.decode_mode,
+                name=f"dec{i}",
+            )
             for i in range(cfg.num_layers)
         ]
         self.enc_ln = _ln("enc_ln", self.cfg.ln_eps)
@@ -79,8 +87,14 @@ class T5(nn.Module):
             x = layer(x, mask)
         return self.enc_ln(x).astype(self.cfg.dtype), mask
 
-    def decode(self, tgt_in: jax.Array, enc: jax.Array, enc_mask: jax.Array) -> jax.Array:
-        x = self.embed(tgt_in)
+    def decode(
+        self,
+        tgt_in: jax.Array,
+        enc: jax.Array,
+        enc_mask: jax.Array,
+        pos_offset: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        x = self.embed(tgt_in, pos_offset=pos_offset)
         for layer in self.dec_layers:
             x = layer(x, enc, enc_mask)
         x = self.dec_ln(x).astype(self.cfg.dtype)
@@ -170,6 +184,72 @@ def make_task(
         batch_size=batch_size,
         targets=targets or {},
     )
+
+
+def init_decode_cache(cfg: TransformerConfig, batch_size: int):
+    """A clean decoder KV cache (zero buffers, index 0) for incremental
+    T5 decoding; buffer length = ``cfg.decode_cache_len or cfg.max_len``.
+    Same discipline as gpt.init_cache: NEVER use ``init(...)["cache"]``
+    directly — flax runs the body during init, leaving a dirty cache."""
+    model = T5(cfg, decode_mode=True)
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0),
+            jnp.zeros((batch_size, 1), jnp.int32),
+            jnp.zeros((batch_size, 1), jnp.int32),
+        )["cache"]
+    )
+    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
+
+
+def greedy_generate(
+    cfg: TransformerConfig,
+    params,
+    src: jax.Array,  # [b, src_len] int32
+    num_tokens: int,
+) -> jax.Array:
+    """Seq2seq greedy decoding: ONE full encoder pass, then a jitted
+    ``lax.scan`` of single-token decoder steps with the self-attention
+    KV cache (cross-attention re-reads the encoder output each step —
+    see DecoderLayer). Starts from BOS and returns the ``[b, num_tokens]``
+    decoded target. Cache buffers are right-sized to the request
+    (``decode_cache_len``), matching the GPT serving path."""
+    import dataclasses as _dc
+
+    b, _src_len = src.shape
+    if num_tokens < 1:
+        raise ValueError("greedy_generate needs num_tokens >= 1")
+    if num_tokens > cfg.max_len:
+        raise ValueError(
+            f"num_tokens {num_tokens} exceeds max_len={cfg.max_len}"
+        )
+    if cfg.decode_cache_len is not None and cfg.decode_cache_len < num_tokens:
+        raise ValueError(
+            f"decode_cache_len={cfg.decode_cache_len} < {num_tokens}"
+        )
+    if cfg.decode_cache_len is None:
+        cfg = _dc.replace(cfg, decode_cache_len=num_tokens)
+    model = T5(cfg, decode_mode=True)
+    enc, enc_mask = model.apply({"params": params}, src, method=T5.encode)
+    cache = init_decode_cache(cfg, b)
+    bos = jnp.full((b,), BOS_ID, src.dtype)
+
+    def step(carry, i):
+        cache, tok = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], enc, enc_mask,
+            pos_offset=i,
+            method=T5.decode,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1).astype(
+            src.dtype
+        )
+        return (mut["cache"], nxt), nxt
+
+    (_, _), outs = jax.lax.scan(step, (cache, bos), jnp.arange(num_tokens))
+    return jnp.swapaxes(outs, 0, 1)
 
 
 def task_for_mesh(

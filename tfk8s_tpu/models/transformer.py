@@ -307,10 +307,17 @@ class EncoderLayer(nn.Module):
 
 
 class DecoderLayer(nn.Module):
-    """Causal self-attention + cross-attention + MLP (T5-style decoder)."""
+    """Causal self-attention + cross-attention + MLP (T5-style decoder).
+    ``decode=True`` turns the self-attention into the incremental
+    KV-cache path (one token per call); cross-attention stays a plain
+    one-query attention over the full encoder output — its K/V
+    projections are recomputed per step (a known constant-factor
+    optimization: caching them per request would save two enc-length
+    matmuls per layer per token)."""
 
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(
@@ -322,7 +329,8 @@ class DecoderLayer(nn.Module):
         cfg = self.cfg
         h = _ln("ln_self", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
-            cfg, causal=True, attn_fn=self.attn_fn, name="self_attn"
+            cfg, causal=True, attn_fn=self.attn_fn, decode=self.decode,
+            name="self_attn",
         )(h)
         h = _ln("ln_cross", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(cfg, attn_fn=self.attn_fn, name="cross_attn")(

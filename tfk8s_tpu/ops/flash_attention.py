@@ -81,7 +81,11 @@ def autotune_blocks(
     import numpy as np
 
     if candidates is None:
-        candidates = [(512, 256), (512, 512), (256, 256), (1024, 512)]
+        # (2048, *) blocks exceed the v5e scoped-VMEM limit in the bwd
+        # kernel (measured: 19.95M vs the 16M cap) — keep them out
+        candidates = [
+            (512, 256), (512, 512), (256, 256), (1024, 512), (1024, 1024),
+        ]
     candidates = [
         (bq, bk) for bq, bk in candidates
         if seq_len % bq == 0 and seq_len % bk == 0

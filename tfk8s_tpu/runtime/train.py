@@ -1023,7 +1023,6 @@ def run_eval(
     # batches (comparable metrics across checkpoints). Falls back to the
     # synthetic held-out stream when unset.
     eval_files = env.get("TFK8S_EVAL_INPUT_FILES")
-    eval_iter = None
     if eval_files:
         from tfk8s_tpu.data.dataset import RecordDataset
 
@@ -1039,6 +1038,15 @@ def run_eval(
                 "TFK8S_EVAL_BATCHES from %d", task.name, avail, eval_batches,
             )
             eval_batches = avail
+        # materialize ONCE: the batches are identical for every
+        # checkpoint by design (unshuffled epoch 0), so paying file IO +
+        # CRC + decode + schema check per evaluation would be pure waste
+        checked = _CheckedFileStream(
+            eval_ds.batches(0),
+            task.make_batch(np.random.default_rng(0), 1),
+            task.batch_size,
+        )
+        eval_set = [next(checked) for _ in range(eval_batches)]
     ckpt = Checkpointer(ctx.checkpoint_dir)
 
     last_seen = -1
@@ -1054,21 +1062,11 @@ def run_eval(
             step = ckpt.latest_step()
             if step is not None and step > last_seen:
                 state = ckpt.restore(state, step=step)
-                if eval_files:
-                    # fresh iterator per checkpoint: identical batches
-                    # every evaluation (epoch 0, unshuffled); the schema
-                    # check gives records/task mismatches the same loud
-                    # error as the training file path
-                    eval_iter = _CheckedFileStream(
-                        eval_ds.batches(0),
-                        task.make_batch(np.random.default_rng(0), 1),
-                        task.batch_size,
-                    )
                 sums: Dict[str, float] = {}
-                for _ in range(eval_batches):
+                for bi in range(eval_batches):
                     host = (
-                        next(eval_iter)
-                        if eval_iter is not None
+                        eval_set[bi]
+                        if eval_files
                         else task.make_batch(np_rng, task.batch_size)
                     )
                     batch = jax.device_put(host, trainer.batch_shardings)
