@@ -36,11 +36,46 @@ bool table_init = [] {
   return true;
 }();
 
-uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc = 0) {
+uint32_t crc32c_sw(const uint8_t* p, size_t n, uint32_t crc) {
   crc = ~crc;
   for (size_t i = 0; i < n; ++i)
     crc = kTable[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
   return ~crc;
+}
+
+#if defined(__x86_64__)
+// The SSE4.2 crc32 instruction computes exactly CRC-32C (Castagnoli) —
+// 8 bytes per instruction vs 1 byte per table lookup (~10x). Compiled
+// with a per-function target attribute and dispatched at runtime so the
+// shared object still loads on pre-SSE4.2 CPUs.
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(const uint8_t* p, size_t n, uint32_t crc) {
+  uint64_t c = ~crc;
+  while (n >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);  // unaligned-safe
+    c = __builtin_ia32_crc32di(c, v);
+    p += 8;
+    n -= 8;
+  }
+  uint32_t c32 = (uint32_t)c;
+  while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
+  return ~c32;
+}
+
+const bool kHaveHwCrc = [] {
+  __builtin_cpu_init();
+  return (bool)__builtin_cpu_supports("sse4.2");
+}();
+#else
+const bool kHaveHwCrc = false;
+uint32_t crc32c_hw(const uint8_t* p, size_t n, uint32_t crc) {
+  return crc32c_sw(p, n, crc);
+}
+#endif
+
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc = 0) {
+  return kHaveHwCrc ? crc32c_hw(p, n, crc) : crc32c_sw(p, n, crc);
 }
 
 uint32_t masked(uint32_t c) {

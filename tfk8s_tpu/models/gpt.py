@@ -125,21 +125,17 @@ def make_task(
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int):
-    """A CLEAN KV cache (zero buffers, index 0) for incremental decode;
-    buffers are ``cfg.decode_cache_len or cfg.max_len`` long — right-size
-    per request, the cache traffic scales with the buffer. Never use
-    ``decoder.init(...)["cache"]`` directly: flax runs the module body
-    during init, so that cache already holds the init token's K/V with
-    cache_index=1 — position 0 would be garbage."""
+    """A CLEAN KV cache for incremental decode; buffers are
+    ``cfg.decode_cache_len or cfg.max_len`` long — right-size per
+    request, the cache traffic scales with the buffer (see
+    ``transformer.clean_cache`` for why init's own cache is unusable)."""
     from tfk8s_tpu.models.bert import BertWithHead
+    from tfk8s_tpu.models.transformer import clean_cache
 
-    decoder = BertWithHead(cfg, causal=True, decode=True)
-    shapes = jax.eval_shape(
-        lambda: decoder.init(
-            jax.random.key(0), jnp.zeros((batch_size, 1), jnp.int32)
-        )["cache"]
+    return clean_cache(
+        BertWithHead(cfg, causal=True, decode=True),
+        jnp.zeros((batch_size, 1), jnp.int32),
     )
-    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
 
 
 def filter_logits(

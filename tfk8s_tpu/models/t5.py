@@ -187,19 +187,16 @@ def make_task(
 
 
 def init_decode_cache(cfg: TransformerConfig, batch_size: int):
-    """A clean decoder KV cache (zero buffers, index 0) for incremental
-    T5 decoding; buffer length = ``cfg.decode_cache_len or cfg.max_len``.
-    Same discipline as gpt.init_cache: NEVER use ``init(...)["cache"]``
-    directly — flax runs the body during init, leaving a dirty cache."""
-    model = T5(cfg, decode_mode=True)
-    shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.key(0),
-            jnp.zeros((batch_size, 1), jnp.int32),
-            jnp.zeros((batch_size, 1), jnp.int32),
-        )["cache"]
+    """A clean decoder KV cache for incremental T5 decoding; buffer
+    length = ``cfg.decode_cache_len or cfg.max_len`` (see
+    ``transformer.clean_cache`` for the dirty-init-cache discipline)."""
+    from tfk8s_tpu.models.transformer import clean_cache
+
+    return clean_cache(
+        T5(cfg, decode_mode=True),
+        jnp.zeros((batch_size, 1), jnp.int32),
+        jnp.zeros((batch_size, 1), jnp.int32),
     )
-    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
 
 
 def greedy_generate(

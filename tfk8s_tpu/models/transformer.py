@@ -343,6 +343,19 @@ class DecoderLayer(nn.Module):
         return out
 
 
+def clean_cache(module: nn.Module, *init_args):
+    """A CLEAN decode cache (zero buffers, index 0) for ``module`` given
+    dummy init args. Never use ``module.init(...)["cache"]`` directly:
+    flax runs the module body during init, so that cache already holds
+    the init tokens' K/V with a nonzero index — position 0 would be
+    garbage. Shared by the GPT and T5 serving paths so a cache-layout
+    change in MultiHeadAttention cannot silently miss one of them."""
+    shapes = jax.eval_shape(
+        lambda: module.init(jax.random.key(0), *init_args)["cache"]
+    )
+    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
+
+
 class Embedder(nn.Module):
     """Token + learned positional embeddings; the token table is reused
     transposed as the output head (weight tying)."""
