@@ -361,3 +361,28 @@ def test_train_task_from_record_dataset(tmp_path):
     _state, history = trainer.fit()
     assert history[0]["loss"] > history[-1]["loss"]
     assert history[-1]["next_token_accuracy"] > 0.4, history[-1]
+
+
+def test_dataset_record_striping_partitions_any_host_count(tmp_path):
+    """shard_by="records" (and the auto fallback when files < hosts):
+    hosts own disjoint covering record stripes even with one file."""
+    files = _write_example_shards(tmp_path, n_files=1, per_file=48)
+    seen = []
+    for host in range(3):
+        ds = RecordDataset(
+            files, batch_size=16, host_index=host, num_hosts=3, shuffle=False
+        )
+        assert ds.shard_by == "records"  # auto: 1 file < 3 hosts
+        assert len(ds) == 16
+        seen.append(
+            {int(b["input"][r, 0]) for b in ds.batches(0) for r in range(16)}
+        )
+    assert seen[0].isdisjoint(seen[1]) and seen[0].isdisjoint(seen[2])
+    assert sorted(seen[0] | seen[1] | seen[2]) == list(range(48))
+
+    # explicit files mode still refuses the under-provisioned case
+    with pytest.raises(ValueError, match="cannot feed"):
+        RecordDataset(files, batch_size=4, host_index=0, num_hosts=3,
+                      shard_by="files")
+    with pytest.raises(ValueError, match="unknown shard_by"):
+        RecordDataset(files, batch_size=4, shard_by="rows")

@@ -4,9 +4,13 @@ background prefetch — the framework's file-backed input pipeline.
 Mirrors the reference ecosystem's per-task input division
 (``/root/reference/k8s-operator.md:6``: each WORKER reads its own slice
 of the input files): a host constructs the dataset with its
-``(host_index, num_hosts)`` and reads ONLY its round-robin share of the
-sorted shard list — host input bandwidth and memory scale 1/hosts, the
-same property the synthetic per-host path in ``runtime/train.py`` has.
+``(host_index, num_hosts)`` and, in the default file-sharded mode, reads
+ONLY its round-robin share of the sorted shard list — host input
+bandwidth and memory scale 1/hosts, the same property the synthetic
+per-host path in ``runtime/train.py`` has. When the file list cannot
+cover the hosts, ``shard_by="records"`` stripes the record sequence
+instead (disjoint per host, but every host index-scans all files — the
+1/hosts IO property applies to file mode only).
 
 Epoch order is a seeded permutation over the host's records (seed folded
 with the epoch number, so every epoch reshuffles deterministically and a
@@ -39,8 +43,36 @@ class RecordDataset:
         decode: Callable[[bytes], Dict[str, np.ndarray]] = example_codec.decode,
         drop_remainder: bool = True,
         verify_crc: bool = True,
+        shard_by: str = "auto",
     ):
-        self.files = shard_files(files, host_index, num_hosts)
+        """``shard_by`` controls the per-host input division:
+
+        - ``"files"``: round-robin over the sorted file list (each host
+          opens ONLY its share — host IO scales 1/hosts; needs at least
+          one file per host);
+        - ``"records"``: every host indexes all files but owns the
+          record stripe ``host_index::num_hosts`` (any file count feeds
+          any host count; the index pass touches every file per host);
+        - ``"auto"`` (default): files when the list covers the hosts,
+          records otherwise.
+        """
+        # dedupe up front: overlapping globs in the input spec must not
+        # double-index records (which would overlap host stripes AND
+        # double-weight the duplicated shard per epoch)
+        unique = sorted(set(files))
+        if shard_by == "auto":
+            shard_by = "files" if len(unique) >= num_hosts else "records"
+        if shard_by not in ("files", "records"):
+            raise ValueError(f"unknown shard_by {shard_by!r}")
+        self.shard_by = shard_by
+        if shard_by == "files":
+            self.files = shard_files(unique, host_index, num_hosts)
+        else:
+            if not 0 <= host_index < num_hosts:
+                raise ValueError(
+                    f"host_index {host_index} not in [0, {num_hosts})"
+                )
+            self.files = unique
         self.batch_size = batch_size
         self.seed = seed
         self.shuffle = shuffle
@@ -54,6 +86,17 @@ class RecordDataset:
             for si, sh in enumerate(self._shards)
             for ri in range(len(sh))
         ]
+        if shard_by == "records":
+            # deterministic disjoint stripe per host over the full
+            # record sequence (file order then record order)
+            total = len(self._addr)
+            self._addr = self._addr[host_index::num_hosts]
+            if not self._addr:
+                raise ValueError(
+                    f"host {host_index}'s record stripe is empty: "
+                    f"{total} records across {self.files} cannot feed "
+                    f"{num_hosts} hosts"
+                )
         if not self._addr:
             raise ValueError(f"no records in shard set {self.files}")
         if drop_remainder and len(self._addr) < batch_size:
