@@ -100,10 +100,15 @@ class RecordDataset:
         if not self._addr:
             raise ValueError(f"no records in shard set {self.files}")
         if drop_remainder and len(self._addr) < batch_size:
+            fix = (
+                "write more records or use fewer hosts"
+                if shard_by == "records"  # stripe size is total/hosts
+                else "write more records or rebalance files across hosts"
+            )
             raise ValueError(
-                f"shard set {self.files} holds {len(self._addr)} records — "
-                f"fewer than one batch of {batch_size} (drop_remainder) — "
-                "write more records or rebalance files across hosts"
+                f"shard set {self.files} holds {len(self._addr)} records "
+                f"for this host — fewer than one batch of {batch_size} "
+                f"(drop_remainder) — {fix}"
             )
 
     def __len__(self) -> int:

@@ -629,12 +629,14 @@ class Trainer:
     def _open_input_files(self, start_step: int):
         """Open the record-shard input stream (input_mode="files"): expand
         ``config.input_files`` (comma-separated paths/globs), give THIS
-        process its round-robin file share and a local batch sized to its
-        addressable rows, validate the first decoded batch against the
-        task's schema, and fast-forward to ``start_step`` (one batch per
-        step) so checkpoint resume continues the exact record stream.
-        Returns an endless iterator of RAW host batches (prepare_batch is
-        applied by the caller)."""
+        process its round-robin file share (or, when the file list can't
+        cover the processes, a record STRIPE — auto fallback, warned
+        loudly, every process then index-scans all files) and a local
+        batch sized to its addressable rows, validate the first decoded
+        batch against the task's schema, and fast-forward to
+        ``start_step`` (one batch per step) so checkpoint resume
+        continues the exact record stream. Returns an endless iterator of
+        RAW host batches (prepare_batch is applied by the caller)."""
         from tfk8s_tpu.data.dataset import RecordDataset
 
         cfg, task = self.config, self.task
@@ -655,11 +657,22 @@ class Trainer:
             num_hosts=nproc,
             seed=cfg.seed,
         )
+        if ds.shard_by == "records" and nproc > 1:
+            # the auto fallback trades the 1/hosts file-IO property for
+            # record striping (every process index-scans ALL files) —
+            # loud, because at scale this is usually a misprovisioned
+            # shard count, not a choice
+            log.warning(
+                "%s: only %d record files for %d processes — falling back "
+                "to RECORD striping (every process reads all files; write "
+                ">= one file per host to restore per-host file IO)",
+                task.name, len(paths), nproc,
+            )
         log.info(
-            "%s: file input — process %d/%d reads %d files / %d records, "
-            "%d rows/step, resuming at batch %d",
-            task.name, jax.process_index(), nproc, len(ds.files), len(ds),
-            local_rows, start_step,
+            "%s: file input (%s-sharded) — process %d/%d reads %d files / "
+            "%d records, %d rows/step, resuming at batch %d",
+            task.name, ds.shard_by, jax.process_index(), nproc,
+            len(ds.files), len(ds), local_rows, start_step,
         )
         # prefetch=0: fit's own _BatchPrefetcher supplies the background
         # thread; a second producer here would double-buffer the batches
