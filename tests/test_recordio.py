@@ -386,3 +386,26 @@ def test_dataset_record_striping_partitions_any_host_count(tmp_path):
                       shard_by="files")
     with pytest.raises(ValueError, match="unknown shard_by"):
         RecordDataset(files, batch_size=4, shard_by="rows")
+
+
+def test_trainer_files_input_composes_with_grad_accum(tmp_path):
+    """files mode + grad_accum_steps: the microbatch reshape happens in
+    prepare_batch AFTER the dataset produces the flat local batch, and
+    the shard plan validates divisibility."""
+    from tfk8s_tpu.models import gpt
+    from tfk8s_tpu.parallel.mesh import make_mesh
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    cfg = gpt.tiny_config()
+    _write_gpt_chain_shards(tmp_path, cfg)
+    task = gpt.make_task(cfg=cfg, seq_len=32, batch_size=16)
+    trainer = Trainer(
+        task,
+        TrainConfig(
+            steps=4, learning_rate=1e-3, log_every=2,
+            input_files=str(tmp_path / "train-*.rio"), grad_accum_steps=2,
+        ),
+        make_mesh(data=8),
+    )
+    _state, history = trainer.fit()
+    assert np.isfinite(history[-1]["loss"])

@@ -666,7 +666,7 @@ class Trainer:
                 "%s: only %d record files for %d processes — falling back "
                 "to RECORD striping (every process reads all files; write "
                 ">= one file per host to restore per-host file IO)",
-                task.name, len(paths), nproc,
+                task.name, len(ds.files), nproc,
             )
         log.info(
             "%s: file input (%s-sharded) — process %d/%d reads %d files / "
