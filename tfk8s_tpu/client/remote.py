@@ -60,6 +60,7 @@ from tfk8s_tpu.client.store import (
     NotFound,
     StoreError,
     Unauthorized,
+    Unavailable,
     Watch,
     WatchEvent,
 )
@@ -89,6 +90,9 @@ def _map_error(status: int, reason: str, message: str) -> StoreError:
         return Conflict(message)
     if status == 410:
         return Gone(message)
+    if status >= 500:
+        # server-side failure: transient by contract, retryable
+        return Unavailable(f"HTTP {status} {reason}: {message}")
     return StoreError(f"HTTP {status} {reason}: {message}")
 
 
@@ -199,7 +203,9 @@ class RemoteStore:
                 e.code, payload.get("reason", ""), payload.get("message", str(e))
             ) from None
         except urllib.error.URLError as e:
-            raise StoreError(f"apiserver unreachable at {url}: {e.reason}") from None
+            raise Unavailable(
+                f"apiserver unreachable at {url}: {e.reason}"
+            ) from None
         if stream:
             return resp
         return json.loads(resp.read() or b"{}")

@@ -17,6 +17,15 @@ documents for the real apiserver:
 - **Finalizer-gated deletion**: deleting an object with finalizers only sets
   ``metadata.deletion_timestamp``; the object is removed when a controller
   strips the last finalizer (k8s-operator.md:36-43).
+- **Durability** (``journal_dir``): every mutation appends one JSONL record
+  to a write-ahead log before it is acknowledged; a snapshot compacts the
+  log periodically. A restarted store replays snapshot+WAL and resumes the
+  SAME resource_version sequence — the etcd-backed persistence the
+  reference's REST contract presupposes (k8s-operator.md:33-43: deletion
+  timestamps and finalizers only make sense on objects that survive a
+  control-plane restart). Watchers reconnecting from a pre-restart rv that
+  the replayed WAL no longer covers get :class:`Gone` and relist — the
+  same recovery path as a compacted etcd.
 """
 
 from __future__ import annotations
@@ -24,6 +33,9 @@ from __future__ import annotations
 import copy
 import enum
 import itertools
+import json
+import logging
+import os
 import queue
 import threading
 import time
@@ -31,6 +43,8 @@ import uuid
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 
 class StoreError(Exception):
@@ -52,6 +66,19 @@ class Conflict(StoreError):
 class Gone(StoreError):
     """Watch requested from a resource_version older than the event buffer —
     the client must relist (HTTP 410 semantics)."""
+
+
+class Unavailable(StoreError):
+    """The apiserver cannot be reached (connection refused/reset, 5xx) —
+    transient by nature; callers with durable obligations (the kubelet's
+    terminal phase writes) retry these, and ONLY these."""
+
+
+class JournalCorrupt(StoreError):
+    """A complete (newline-terminated) WAL record failed to decode —
+    mid-file corruption or a schema break. Refusing to start is the only
+    safe response: truncating would destroy acked records written after
+    the bad one."""
 
 
 class Unauthorized(StoreError):
@@ -125,9 +152,22 @@ def match_labels(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
 
 
 class ClusterStore:
-    """Thread-safe object store keyed by (kind, namespace/name)."""
+    """Thread-safe object store keyed by (kind, namespace/name).
 
-    def __init__(self, history_limit: int = 4096) -> None:
+    With ``journal_dir`` set, the store is durable: ``snapshot.json`` holds
+    a compacted full state, ``wal.jsonl`` the event log since; construction
+    replays both and resumes the rv sequence. ``fsync=False`` trades
+    power-loss durability for write latency (kill -9 survival only needs
+    the page cache, so tests and the control-plane bench may disable it).
+    """
+
+    def __init__(
+        self,
+        history_limit: int = 4096,
+        journal_dir: Optional[str] = None,
+        compact_every: int = 4096,
+        fsync: bool = True,
+    ) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[str, Dict[str, Any]] = {}
         self._rv = itertools.count(1)
@@ -135,6 +175,178 @@ class ClusterStore:
         # ring buffer of (rv, WatchEvent) for replay
         self._history: "deque[Tuple[int, WatchEvent]]" = deque(maxlen=history_limit)
         self._watchers: List[Tuple[str, Watch]] = []
+        self._journal_dir = journal_dir
+        self._compact_every = compact_every
+        self._fsync = fsync
+        self._wal = None  # append handle on wal.jsonl
+        self._wal_records = 0
+        self._poisoned = False
+        if journal_dir is not None:
+            self._open_journal()
+
+    # -- journal ------------------------------------------------------------
+
+    @property
+    def _snapshot_path(self) -> str:
+        return os.path.join(self._journal_dir, "snapshot.json")
+
+    @property
+    def _wal_path(self) -> str:
+        return os.path.join(self._journal_dir, "wal.jsonl")
+
+    def _open_journal(self) -> None:
+        """Replay snapshot + WAL, then open the WAL for append. A torn final
+        line (kill -9 mid-write) is truncated away — everything before it
+        was acknowledged with a complete line, so nothing acked is lost."""
+        from tfk8s_tpu.api import serde  # api layer; no import cycle
+
+        os.makedirs(self._journal_dir, exist_ok=True)
+        if os.path.exists(self._snapshot_path):
+            with open(self._snapshot_path) as f:
+                snap = json.load(f)
+            self._last_rv = snap["rv"]
+            for data in snap["objects"]:
+                obj = serde.decode_object(data)
+                self._bucket(obj.kind)[obj.metadata.key] = obj
+        good_end = 0
+        if os.path.exists(self._wal_path):
+            with open(self._wal_path, "rb") as f:
+                for line in f:
+                    if not line.endswith(b"\n"):
+                        # A torn tail is the expected kill -9 artifact: the
+                        # record was never acked (ack follows the full-line
+                        # write), so truncating exactly it loses nothing.
+                        log.warning(
+                            "journal: truncating torn WAL tail (%d bytes)", len(line)
+                        )
+                        break
+                    try:
+                        rec = json.loads(line)
+                        obj = serde.decode_object(rec["obj"])
+                        etype = EventType(rec["type"])
+                    except (ValueError, KeyError) as e:
+                        # A COMPLETE line that fails to decode is mid-file
+                        # corruption (or a schema break). Acked records may
+                        # follow it — truncating here would destroy them, so
+                        # refuse to start instead (etcd does the same).
+                        raise JournalCorrupt(
+                            f"{self._wal_path} byte {good_end}: "
+                            f"undecodable complete record: {e}"
+                        ) from e
+                    bucket = self._bucket(obj.kind)
+                    if etype == EventType.DELETED:
+                        bucket.pop(obj.metadata.key, None)
+                    else:
+                        bucket[obj.metadata.key] = obj
+                    self._last_rv = max(self._last_rv, rec["rv"])
+                    self._history.append((rec["rv"], WatchEvent(etype, obj)))
+                    self._wal_records += 1
+                    good_end += len(line)
+        self._rv = itertools.count(self._last_rv + 1)
+        self._wal = open(self._wal_path, "ab")
+        if good_end != self._wal.tell():
+            self._wal.truncate(good_end)
+            self._wal.seek(good_end)
+
+    def _journal(self, etype: EventType, obj: Any) -> None:
+        """Append one event record; called under the lock, BEFORE watchers
+        see the event, so nothing observable ever precedes the WAL.
+
+        A failed append must leave the WAL byte-identical to its last good
+        state: a BufferedWriter that kept (or half-wrote) the failed
+        record's bytes would prepend them to the NEXT successful append —
+        either resurrecting a never-acked object after restart or fusing
+        two lines into one undecodable record (JournalCorrupt on the next
+        start). If even the rollback fails, the journal is poisoned and
+        every further mutation is refused — availability is the right
+        thing to sacrifice for a store whose point is durability."""
+        from tfk8s_tpu.api import serde
+
+        if self._poisoned:
+            raise StoreError(
+                "journal poisoned by an earlier unrecoverable write error; "
+                "refusing mutations (restart the apiserver to re-replay)"
+            )
+        rec = {
+            "rv": obj.metadata.resource_version,
+            "type": etype.value,
+            "obj": serde.to_dict(obj),
+        }
+        start = self._wal.tell()
+        try:
+            self._wal.write((json.dumps(rec) + "\n").encode())
+            self._wal.flush()
+            if self._fsync:
+                os.fsync(self._wal.fileno())
+        except OSError:
+            try:
+                self._wal.close()  # may raise re-flushing; superseded below
+            except OSError:
+                pass
+            try:
+                with open(self._wal_path, "ab") as fix:
+                    fix.truncate(start)
+                self._wal = open(self._wal_path, "ab")
+            except OSError:
+                self._poisoned = True
+                log.error(
+                    "journal: could not roll back failed append; poisoning "
+                    "the store (WAL intact through rv %d)", self._last_rv,
+                )
+            raise
+        self._wal_records += 1
+
+    def _compact(self) -> None:
+        """Atomic snapshot of full state, then truncate the WAL. Watchers
+        holding pre-snapshot rvs will relist via Gone after a restart —
+        exactly etcd compaction semantics.
+
+        Ordering matters: the snapshot (and, under fsync, its directory
+        entry) must be durable BEFORE the WAL is truncated, or a power cut
+        between the two could leave the old snapshot + an empty WAL —
+        losing everything since the previous compaction.
+
+        Runs synchronously under the store lock — a deliberate tradeoff:
+        at this store's scale (thousands of objects) the pause is
+        single-digit ms every ``compact_every`` writes; a background
+        compactor would need WAL segment rotation for no measured win
+        (the control-plane bench rides this path).
+        """
+        from tfk8s_tpu.api import serde
+
+        snap = {
+            "rv": self._last_rv,
+            "objects": [
+                serde.to_dict(obj)
+                for bucket in self._objects.values()
+                for obj in bucket.values()
+            ],
+        }
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self._snapshot_path)
+        if self._fsync:
+            # persist the rename itself before dropping the WAL
+            dir_fd = os.open(self._journal_dir, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        # truncate through the live handle — no close/reopen window in
+        # which a failure could leave the store without a WAL handle
+        self._wal.truncate(0)
+        self._wal.seek(0)
+        self._wal_records = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
 
     # -- internals ----------------------------------------------------------
 
@@ -142,8 +354,28 @@ class ClusterStore:
         self._last_rv = next(self._rv)
         return self._last_rv
 
-    def _emit(self, etype: EventType, obj: Any) -> None:
+    def _emit(self, etype: EventType, obj: Any, apply=None) -> None:
+        """Journal, then commit, then notify — in that order. ``apply``
+        performs the actual bucket mutation; deferring it until after the
+        WAL append succeeds keeps the log write-AHEAD: a failed append
+        (ENOSPC, dead disk) raises to the client with NO state change, so
+        readers can never observe an object that a restart would forget."""
         ev = WatchEvent(etype, copy.deepcopy(obj))
+        if self._wal is not None:
+            self._journal(etype, ev.object)
+        if apply is not None:
+            apply()
+        # compact only AFTER the mutation is applied — a snapshot taken
+        # between journal and apply would miss the in-flight object and the
+        # WAL truncation would then destroy its only record. A compaction
+        # failure must NOT fail the (already committed and journaled)
+        # mutation: log it and retry at the next write, when
+        # _wal_records will still be over threshold.
+        if self._wal is not None and self._wal_records >= self._compact_every:
+            try:
+                self._compact()
+            except OSError as e:
+                log.warning("journal: compaction failed (will retry): %s", e)
         self._history.append((obj.metadata.resource_version, ev))
         for kind, w in list(self._watchers):
             if kind == obj.kind:
@@ -167,8 +399,9 @@ class ClusterStore:
                 stored.metadata.creation_timestamp or time.time()
             )
             stored.metadata.resource_version = self._bump()
-            bucket[k] = stored
-            self._emit(EventType.ADDED, stored)
+            self._emit(
+                EventType.ADDED, stored, apply=lambda: bucket.__setitem__(k, stored)
+            )
             return copy.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
@@ -219,13 +452,15 @@ class ClusterStore:
                 stored.metadata.deletion_timestamp is not None
                 and not stored.metadata.finalizers
             ):
-                del bucket[k]
                 stored.metadata.resource_version = self._bump()
-                self._emit(EventType.DELETED, stored)
+                self._emit(
+                    EventType.DELETED, stored, apply=lambda: bucket.pop(k)
+                )
                 return copy.deepcopy(stored)
             stored.metadata.resource_version = self._bump()
-            bucket[k] = stored
-            self._emit(EventType.MODIFIED, stored)
+            self._emit(
+                EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)
+            )
             return copy.deepcopy(stored)
 
     def update_status(self, obj: Any) -> Any:
@@ -250,8 +485,9 @@ class ClusterStore:
             stored = copy.deepcopy(current)
             stored.status = copy.deepcopy(obj.status)
             stored.metadata.resource_version = self._bump()
-            bucket[k] = stored
-            self._emit(EventType.MODIFIED, stored)
+            self._emit(
+                EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)
+            )
             return copy.deepcopy(stored)
 
     def delete(self, kind: str, namespace: str, name: str) -> Any:
@@ -265,14 +501,19 @@ class ClusterStore:
             current = bucket[k]
             if current.metadata.finalizers:
                 if current.metadata.deletion_timestamp is None:
-                    current.metadata.deletion_timestamp = time.time()
-                    current.metadata.resource_version = self._bump()
-                    self._emit(EventType.MODIFIED, current)
+                    marked = copy.deepcopy(current)
+                    marked.metadata.deletion_timestamp = time.time()
+                    marked.metadata.resource_version = self._bump()
+                    self._emit(
+                        EventType.MODIFIED, marked,
+                        apply=lambda: bucket.__setitem__(k, marked),
+                    )
+                    return copy.deepcopy(marked)
                 return copy.deepcopy(current)
-            del bucket[k]
-            current.metadata.resource_version = self._bump()
-            self._emit(EventType.DELETED, current)
-            return copy.deepcopy(current)
+            removed = copy.deepcopy(current)
+            removed.metadata.resource_version = self._bump()
+            self._emit(EventType.DELETED, removed, apply=lambda: bucket.pop(k))
+            return copy.deepcopy(removed)
 
     # -- watch --------------------------------------------------------------
 
@@ -284,7 +525,11 @@ class ClusterStore:
             w = Watch()
             if since_rv is not None and since_rv < self._last_rv:
                 oldest_buffered = self._history[0][0] if self._history else None
-                if oldest_buffered is not None and since_rv < oldest_buffered - 1:
+                # oldest_buffered None with last_rv > 0 means the store was
+                # restored from a compacted journal — the gap to since_rv is
+                # unreplayable, so the client must relist (410), the same
+                # contract as a compacted etcd.
+                if oldest_buffered is None or since_rv < oldest_buffered - 1:
                     raise Gone(
                         f"resource_version {since_rv} is too old "
                         f"(oldest buffered: {oldest_buffered})"

@@ -76,6 +76,13 @@ def _build_parser() -> argparse.ArgumentParser:
     p_api.add_argument("--self-signed", default="", metavar="DIR",
                        help="mint a CA + server cert into DIR and serve TLS "
                        "(dev/test; overrides --tls-cert/--tls-key)")
+    p_api.add_argument("--journal-dir", default="", dest="journal_dir",
+                       help="directory for the write-ahead log + snapshot; "
+                            "cluster state survives apiserver restarts "
+                            "(empty = in-memory only)")
+    p_api.add_argument("--no-fsync", action="store_true", dest="no_fsync",
+                       help="journal without fsync (kill-9 safe via page "
+                            "cache, not power-loss safe)")
     p_api.add_argument("--token-file", default="",
                        help="static token file 'token,user[,readonly]' per "
                        "line; enables authentication (anonymous -> 401)")
@@ -312,9 +319,15 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
                       "readonly credentials; nothing usable to embed")
             return 2
 
-    server = APIServer(
-        ClusterStore(), host=args.host, port=args.port, tls=tls, auth=auth
+    store = ClusterStore(
+        journal_dir=args.journal_dir or None,
+        fsync=not args.no_fsync,
     )
+    if args.journal_dir:
+        log.info(
+            "journal: %s (replayed to rv %d)", args.journal_dir, store.resource_version
+        )
+    server = APIServer(store, host=args.host, port=args.port, tls=tls, auth=auth)
     if args.write_kubeconfig:
         kc: dict = {"server": server.url}
         if ca_pem:

@@ -21,13 +21,14 @@ import collections
 import logging
 import os
 import threading
+import time
 import traceback
 from typing import Deque, Dict, List, Optional, Tuple
 
 from tfk8s_tpu.api.types import Pod, PodPhase
 from tfk8s_tpu.client.clientset import Clientset
 from tfk8s_tpu.client.informer import ResourceEventHandler, SharedIndexInformer
-from tfk8s_tpu.client.store import Conflict, NotFound
+from tfk8s_tpu.client.store import Conflict, NotFound, Unavailable
 from tfk8s_tpu.runtime import progress as _progress
 from tfk8s_tpu.runtime import registry
 from tfk8s_tpu.utils.logging import get_logger
@@ -54,6 +55,11 @@ NODE_LEASE_PREFIX = "node-"
 # settings applied after first import were silently ignored).
 NODE_LEASE_DURATION_DEFAULT_S = 20.0
 NODE_LEASE_RENEW_DEFAULT_S = 4.0
+# How long a pod phase write keeps retrying through an apiserver outage.
+# Sized to cover a full control-plane restart (journal replay + interpreter
+# start, tens of seconds under load) with margin; teardown paths exit
+# early via the kubelet stop event.
+STATUS_WRITE_RETRY_S = 300.0
 
 
 class _PodLogRouter(logging.Handler):
@@ -327,31 +333,59 @@ class LocalKubelet:
         exit_code=None, log_tail: Optional[List[str]] = None,
         training: Optional[Dict[str, float]] = None,
     ) -> bool:
+        """Phase writes must survive a transient apiserver outage: a
+        SUCCEEDED/FAILED result dropped on the floor leaves the pod Running
+        forever in a journal-restored store (no later event corrects it).
+        Unavailable (connection refused/reset, 5xx) retries with backoff
+        until the kubelet stops or the outage outlasts
+        ``STATUS_WRITE_RETRY_S``; permanent errors (401/403/422) fail fast
+        — they will never succeed by waiting. Conflict retries are folded
+        into the same loop (each iteration re-reads)."""
         ns, name = pod_key.split("/", 1)
-        for _ in range(5):
+        deadline = time.monotonic() + STATUS_WRITE_RETRY_S
+        conflicts = 0
+        while True:
             try:
                 current = self.cs.pods(ns).get(name)
-            except NotFound:
-                return False
-            if current.metadata.uid != uid:
-                return False  # a successor pod took this name; not ours
-            current.status.phase = phase
-            current.status.message = message
-            current.status.exit_code = exit_code
-            current.status.host = self.name
-            if log_tail is not None:
-                current.status.log_tail = log_tail
-            if training:
-                current.status.training = dict(training)
-            try:
+                if current.metadata.uid != uid:
+                    return False  # a successor pod took this name; not ours
+                current.status.phase = phase
+                current.status.message = message
+                current.status.exit_code = exit_code
+                current.status.host = self.name
+                if log_tail is not None:
+                    current.status.log_tail = log_tail
+                if training:
+                    current.status.training = dict(training)
                 self.cs.pods(ns).update_status(current)
                 return True
-            except Conflict:
-                continue
             except NotFound:
                 return False
-        log.warning("%s: giving up updating %s to %s", self.name, pod_key, phase)
-        return False
+            except Conflict:
+                conflicts += 1
+                if conflicts > 5:
+                    log.warning(
+                        "%s: giving up updating %s to %s (conflicts)",
+                        self.name, pod_key, phase,
+                    )
+                    return False
+                continue
+            except (Unavailable, OSError) as e:
+                stopping = self._stop is not None and self._stop.is_set()
+                if stopping or time.monotonic() > deadline:
+                    log.warning(
+                        "%s: dropping %s -> %s (%s; %s)", self.name, pod_key,
+                        phase, e, "stopping" if stopping else "outage too long",
+                    )
+                    return False
+                log.info(
+                    "%s: apiserver unreachable writing %s -> %s; retrying: %s",
+                    self.name, pod_key, phase, e,
+                )
+                if self._stop is not None:
+                    self._stop.wait(1.0)
+                else:
+                    time.sleep(1.0)
 
     def _run_pod(self, pod: Pod, pod_stop: threading.Event) -> None:
         key, uid = pod.metadata.key, pod.metadata.uid
