@@ -403,6 +403,43 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
+    def do_PATCH(self) -> None:
+        """JSON merge-patch (RFC 7386) on objects and /status — the verb
+        `kubectl apply/scale` and controller status writes ride so
+        concurrent writers touch disjoint fields instead of fighting over
+        whole-object PUTs (k8s-operator.md:33-34)."""
+        if self._gate(write=True) is None:
+            return
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype not in ("application/merge-patch+json", "application/json"):
+            self._send_json(
+                415,
+                {
+                    "reason": "UnsupportedMediaType",
+                    "message": f"PATCH requires application/merge-patch+json, "
+                               f"got {ctype!r}",
+                },
+            )
+            return
+        route = self._route()
+        if route is None or route[2] is None:
+            self._send_json(404, {"reason": "NotFound", "message": self.path})
+            return
+        kind, ns, name, is_status, _q = route
+        try:
+            patch = self._read_body()
+            # admission runs on the MERGED object inside the store's
+            # critical section — a patch cannot sneak an invalid spec
+            # past validation, and a rejected patch commits nothing
+            patched = self.server.store.patch(
+                kind, ns or "default", name, patch,
+                subresource="status" if is_status else None,
+                admit=self._admit,
+            )
+            self._send_json(200, serde.to_wire(patched))
+        except Exception as e:  # noqa: BLE001
+            self._send_store_error(e)
+
     def do_DELETE(self) -> None:
         if self._gate(write=True) is None:
             return
@@ -537,7 +574,7 @@ class APIServer(ThreadingHTTPServer):
 
     def resource_list(self) -> Dict[str, Any]:
         # metav1.APIResourceList for the group-version (kubectl api-resources)
-        verbs = ["create", "delete", "get", "list", "update", "watch"]
+        verbs = ["create", "delete", "get", "list", "patch", "update", "watch"]
         return {
             "kind": "APIResourceList",
             "apiVersion": "v1",
@@ -556,7 +593,7 @@ class APIServer(ThreadingHTTPServer):
                     "name": f"{plural}/status",
                     "kind": kind,
                     "namespaced": True,
-                    "verbs": ["update"],
+                    "verbs": ["patch", "update"],
                 }
                 for plural, kind in sorted(PLURALS.items())
             ],

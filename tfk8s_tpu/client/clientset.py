@@ -81,6 +81,23 @@ class TypedClient:
         self._limiter.accept()
         return self._store.update_status(obj)
 
+    def patch(self, name: str, patch: Dict[str, Any]) -> Any:
+        """JSON merge-patch (wire-form keys): write only the fields you
+        own; no resourceVersion needed, so concurrent writers touching
+        disjoint fields never conflict (over the wire: ``PATCH
+        .../{name}`` with application/merge-patch+json)."""
+        self._limiter.accept()
+        return self._store.patch(self.kind, self._ns(), name, patch)
+
+    def patch_status(self, name: str, patch: Dict[str, Any]) -> Any:
+        """Merge-patch confined to ``status`` (``PATCH .../{name}/status``).
+        ``patch`` may be the full wire object or just ``{"status": ...}``;
+        only its status applies."""
+        self._limiter.accept()
+        return self._store.patch(
+            self.kind, self._ns(), name, patch, subresource="status"
+        )
+
     def delete(self, name: str) -> Any:
         self._limiter.accept()
         return self._store.delete(self.kind, self._ns(), name)

@@ -41,7 +41,18 @@ class _RecordingClient(TypedClient):
     def create(self, obj: Any) -> Any:
         a = Action("create", self.kind, self._ns(obj), obj.metadata.name)
         handled, result = self._react(a, obj)
-        return result if handled else super().create(obj)
+        if handled:
+            return result
+        if obj.kind == "TPUJob":
+            # admission parity with the real apiserver (_admit): defaults
+            # are applied by the API machinery before persisting, so the
+            # STORED object carries them — controllers must not need a
+            # whole-object write to make defaults durable. (Validation is
+            # deliberately skipped: tests create odd specs on purpose.)
+            from tfk8s_tpu.api import set_defaults
+
+            set_defaults(obj)
+        return super().create(obj)
 
     def get(self, name: str) -> Any:
         a = Action("get", self.kind, self._ns(), name)
@@ -62,6 +73,16 @@ class _RecordingClient(TypedClient):
         a = Action("update_status", self.kind, self._ns(obj), obj.metadata.name)
         handled, result = self._react(a, obj)
         return result if handled else super().update_status(obj)
+
+    def patch(self, name: str, patch) -> Any:
+        a = Action("patch", self.kind, self._ns(), name)
+        handled, result = self._react(a, patch)
+        return result if handled else super().patch(name, patch)
+
+    def patch_status(self, name: str, patch) -> Any:
+        a = Action("patch_status", self.kind, self._ns(), name)
+        handled, result = self._react(a, patch)
+        return result if handled else super().patch_status(name, patch)
 
     def delete(self, name: str) -> Any:
         a = Action("delete", self.kind, self._ns(), name)

@@ -176,6 +176,7 @@ class RemoteStore:
         body: Optional[Dict[str, Any]] = None,
         query: Optional[Dict[str, str]] = None,
         stream: bool = False,
+        content_type: str = "application/json",
     ):
         url = self.base_url + path
         if query:
@@ -183,7 +184,7 @@ class RemoteStore:
         data = json.dumps(body).encode() if body is not None else None
         headers: Dict[str, str] = {"User-Agent": self.user_agent}
         if data:
-            headers["Content-Type"] = "application/json"
+            headers["Content-Type"] = content_type
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(url, data=data, method=method, headers=headers)
@@ -258,6 +259,24 @@ class RemoteStore:
             self._path(obj.kind, obj.metadata.namespace or "default", obj.metadata.name)
             + "/status",
             body=serde.to_wire(obj),
+        )
+        return serde.decode_object(data)
+
+    def patch(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Dict[str, Any],
+        subresource: Optional[str] = None,
+        admit=None,  # server-side concern; accepted for surface parity
+    ) -> Any:
+        path = self._path(kind, namespace, name)
+        if subresource:
+            path += f"/{subresource}"
+        data = self._request(
+            "PATCH", path, body=patch,
+            content_type="application/merge-patch+json",
         )
         return serde.decode_object(data)
 

@@ -151,6 +151,54 @@ def match_labels(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge-patch: dicts merge recursively, ``null`` deletes
+    a key, everything else (including lists) replaces wholesale."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    result = dict(target) if isinstance(target, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            result.pop(k, None)
+        else:
+            result[k] = merge_patch(result.get(k), v)
+    return result
+
+
+_MISSING = object()
+
+
+def replace_patch(current: Any, desired: Any) -> Dict[str, Any]:
+    """The inverse of :func:`merge_patch`: the smallest merge-patch that
+    transforms ``current`` into exactly ``desired`` — keys present in
+    current but absent from desired become explicit ``null`` deletions.
+    This is how `apply` gets REPLACE semantics (a field removed from the
+    manifest really goes away) over the merge-patch wire verb. Returns
+    ``{}`` when nothing differs."""
+    p = _replace_patch(current, desired)
+    return {} if p is _MISSING else p
+
+
+def _replace_patch(current: Any, desired: Any) -> Any:
+    if isinstance(desired, dict) and isinstance(current, dict):
+        patch = {}
+        for k, v in desired.items():
+            cv = current.get(k, _MISSING)
+            if cv is _MISSING:
+                patch[k] = copy.deepcopy(v)
+            else:
+                sub = _replace_patch(cv, v)
+                if sub is not _MISSING:
+                    patch[k] = sub
+        for k in current:
+            if k not in desired:
+                patch[k] = None
+        return patch if patch else _MISSING
+    if current == desired:
+        return _MISSING
+    return copy.deepcopy(desired)
+
+
 class ClusterStore:
     """Thread-safe object store keyed by (kind, namespace/name).
 
@@ -484,6 +532,86 @@ class ClusterStore:
                 raise StoreError(f"{obj.kind} has no status subresource")
             stored = copy.deepcopy(current)
             stored.status = copy.deepcopy(obj.status)
+            stored.metadata.resource_version = self._bump()
+            self._emit(
+                EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)
+            )
+            return copy.deepcopy(stored)
+
+    def patch(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Dict[str, Any],
+        subresource: Optional[str] = None,
+        admit=None,
+    ) -> Any:
+        """JSON merge-patch (RFC 7386) against the stored object — the
+        PATCH verb the reference's typed client is built on
+        (k8s-operator.md:33-34): writers touch only the fields they own,
+        so an operator's status write and a CLI spec write never fight
+        over resourceVersion the way whole-object PUTs do.
+
+        ``patch`` is in the Kubernetes WIRE form (camelCase keys, as
+        ``serde.to_wire`` produces). Unlike update(), no resourceVersion
+        is required — last-writer-wins on the touched fields; a patch
+        that DOES carry ``metadata.resourceVersion`` turns it into an
+        optimistic precondition (k8s semantics). Server-owned metadata
+        (uid, creationTimestamp, deletionTimestamp) cannot be patched.
+        ``subresource='status'`` confines the patch to ``status`` exactly
+        as update_status confines PUT. ``admit`` (server-side) runs on the
+        MERGED object before anything commits — a rejected patch leaves no
+        trace, the same boundary a validating webhook gives PUT."""
+        from tfk8s_tpu.api import serde
+
+        with self._lock:
+            bucket = self._bucket(kind)
+            k = _key(namespace, name)
+            if k not in bucket:
+                raise NotFound(f"{kind} {k} not found")
+            current = bucket[k]
+            patch = copy.deepcopy(patch)
+            pre_rv = (patch.get("metadata") or {}).pop("resourceVersion", None)
+            if pre_rv is not None and int(pre_rv) != current.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {k}: resourceVersion precondition {pre_rv} != "
+                    f"{current.metadata.resource_version}"
+                )
+            if subresource == "status":
+                patch = {"status": patch.get("status", {})}
+            elif subresource is not None:
+                raise StoreError(f"unknown subresource {subresource!r}")
+            else:
+                # main-resource writes never touch status (subresource
+                # isolation, mirroring update())
+                patch.pop("status", None)
+            cur_wire = serde.to_wire(current)
+            merged = merge_patch(cur_wire, patch)
+            # identity is immutable under PATCH (the real apiserver rejects
+            # name changes): restore kind/apiVersion/name/namespace BEFORE
+            # decoding — a patched kind would otherwise re-type the object
+            # into the wrong dataclass inside the old kind's bucket
+            merged["kind"] = current.kind
+            merged["apiVersion"] = cur_wire["apiVersion"]
+            merged.setdefault("metadata", {})
+            merged["metadata"]["name"] = current.metadata.name
+            merged["metadata"]["namespace"] = current.metadata.namespace
+            stored = serde.decode_object(merged)
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+            stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+            if admit is not None and subresource is None:
+                admit(stored)  # raises -> nothing committed
+            if (
+                stored.metadata.deletion_timestamp is not None
+                and not stored.metadata.finalizers
+            ):
+                # stripping the last finalizer via PATCH completes the
+                # delete, exactly like update()
+                stored.metadata.resource_version = self._bump()
+                self._emit(EventType.DELETED, stored, apply=lambda: bucket.pop(k))
+                return copy.deepcopy(stored)
             stored.metadata.resource_version = self._bump()
             self._emit(
                 EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)

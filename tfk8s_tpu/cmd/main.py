@@ -568,7 +568,6 @@ def _cmd_scale(args: argparse.Namespace) -> int:
     accelerator cannot host — surfaced as-is."""
     from tfk8s_tpu.api.types import ReplicaType
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
-    from tfk8s_tpu.client.store import Conflict
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
     try:
@@ -577,46 +576,39 @@ def _cmd_scale(args: argparse.Namespace) -> int:
         log.error("scale: unknown replica type %r (use %s)",
                   args.replica_type, [t.value for t in ReplicaType])
         return 1
-    for _ in range(5):  # optimistic-concurrency retry against the operator
-        job = cs.tpujobs(args.namespace).get(args.name)
-        if rtype not in job.spec.replica_specs:
-            log.error("scale: job %s has no %s replica set",
-                      args.name, rtype.value)
-            return 1
-        job.spec.replica_specs[rtype].replicas = args.replicas
-        try:
-            cs.tpujobs(args.namespace).update(job)
-            print(f"tpujob {args.namespace}/{args.name} scaled: "
-                  f"{rtype.value}={args.replicas}")
-            return 0
-        except Conflict:
-            continue
-    log.error("scale: persistent write conflict; try again")
-    return 1
+    job = cs.tpujobs(args.namespace).get(args.name)
+    if rtype not in job.spec.replica_specs:
+        log.error("scale: job %s has no %s replica set",
+                  args.name, rtype.value)
+        return 1
+    # merge-patch touches ONLY the replica count — no resourceVersion, no
+    # conflict with the operator's concurrent status writes
+    cs.tpujobs(args.namespace).patch(
+        args.name,
+        {"spec": {"replicaSpecs": {rtype.value: {"replicas": args.replicas}}}},
+    )
+    print(f"tpujob {args.namespace}/{args.name} scaled: "
+          f"{rtype.value}={args.replicas}")
+    return 0
 
 
 def _set_suspend(args: argparse.Namespace, value: bool) -> int:
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
-    from tfk8s_tpu.client.store import Conflict
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
-    verb = "suspend" if value else "resume"
-    for _ in range(5):
-        job = cs.tpujobs(args.namespace).get(args.name)
-        if job.spec.run_policy.suspend == value:
-            print(f"tpujob {args.namespace}/{args.name} already "
-                  f"{'suspended' if value else 'running'}")
-            return 0
-        job.spec.run_policy.suspend = value
-        try:
-            cs.tpujobs(args.namespace).update(job)
-            print(f"tpujob {args.namespace}/{args.name} "
-                  f"{'suspended' if value else 'resumed'}")
-            return 0
-        except Conflict:
-            continue
-    log.error("%s: persistent write conflict; try again", verb)
-    return 1
+    job = cs.tpujobs(args.namespace).get(args.name)
+    if job.spec.run_policy.suspend == value:
+        print(f"tpujob {args.namespace}/{args.name} already "
+              f"{'suspended' if value else 'running'}")
+        return 0
+    # merge-patch on the one field this verb owns — conflict-free by
+    # construction
+    cs.tpujobs(args.namespace).patch(
+        args.name, {"spec": {"runPolicy": {"suspend": value}}}
+    )
+    print(f"tpujob {args.namespace}/{args.name} "
+          f"{'suspended' if value else 'resumed'}")
+    return 0
 
 
 def _cmd_suspend(args: argparse.Namespace) -> int:
@@ -628,45 +620,47 @@ def _cmd_resume(args: argparse.Namespace) -> int:
 
 
 def _cmd_apply(args: argparse.Namespace) -> int:
-    """kubectl-apply parity: create the manifest's job, or update it in
-    place when it already exists (spec replaced; status untouched)."""
+    """kubectl-apply parity: create the manifest's job, or PATCH its spec
+    when it already exists. The patch is computed as the exact diff
+    (replace_patch): fields removed from the manifest get explicit nulls,
+    so apply keeps REPLACE semantics over the conflict-free merge-patch
+    verb — no resourceVersion, no retry loop (status stays untouched by
+    the subresource isolation on the server)."""
+    from tfk8s_tpu.api import serde
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
-    from tfk8s_tpu.client.store import AlreadyExists, Conflict, NotFound
+    from tfk8s_tpu.client.store import AlreadyExists, NotFound, replace_patch
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
     job = _load_job_for_namespace(args, "apply")
     client = cs.tpujobs(args.namespace)
-    try:
-        client.create(job)
-        print(f"tpujob {args.namespace}/{job.metadata.name} created")
-        return 0
-    except AlreadyExists:
-        pass
-    for _ in range(5):
+    for _ in range(2):  # second pass only for the delete/create races
+        try:
+            client.create(job)
+            print(f"tpujob {args.namespace}/{job.metadata.name} created")
+            return 0
+        except AlreadyExists:
+            pass
         try:
             current = client.get(job.metadata.name)
-        except NotFound:  # deleted since the AlreadyExists; recreate
-            try:
-                client.create(job)
-            except AlreadyExists:
-                continue
-            print(f"tpujob {args.namespace}/{job.metadata.name} created")
-            return 0
-        current.spec = job.spec
-        try:
-            client.update(current)
+            # default the manifest locally before diffing: current is
+            # already defaulted, so an undefaulted desired spec would diff
+            # (and null) every server-filled field just for admission to
+            # put it back — and "unchanged" would never trigger
+            from tfk8s_tpu.api import set_defaults
+
+            set_defaults(job)
+            patch = replace_patch(
+                serde.to_wire(current.spec), serde.to_wire(job.spec)
+            )
+            if not patch:
+                print(f"tpujob {args.namespace}/{job.metadata.name} unchanged")
+                return 0
+            client.patch(job.metadata.name, {"spec": patch})
             print(f"tpujob {args.namespace}/{job.metadata.name} configured")
             return 0
-        except Conflict:
+        except NotFound:  # deleted since AlreadyExists; loop recreates
             continue
-        except NotFound:  # deleted between get and update; recreate
-            try:
-                client.create(job)
-            except AlreadyExists:
-                continue  # re-created concurrently; retry the update path
-            print(f"tpujob {args.namespace}/{job.metadata.name} created")
-            return 0
-    log.error("apply: persistent write conflict; try again")
+    log.error("apply: object is churning (concurrent delete/create); try again")
     return 1
 
 

@@ -248,10 +248,21 @@ class TPUJobController:
             return
 
         # Ensure our finalizer before creating anything it must clean up.
+        # The finalizers LIST replaces wholesale under merge-patch, so the
+        # write carries an rv precondition: without it a finalizer some
+        # other writer added concurrently would be silently clobbered.
         if FINALIZER not in job.metadata.finalizers:
-            job.metadata.finalizers.append(FINALIZER)
-            self.cs.tpujobs(ns).update(job)
-            return  # updated object re-enqueues via the watch
+            try:
+                self.cs.tpujobs(ns).patch(
+                    job.metadata.name,
+                    {"metadata": {
+                        "resourceVersion": str(job.metadata.resource_version),
+                        "finalizers": job.metadata.finalizers + [FINALIZER],
+                    }},
+                )
+            except Conflict:
+                self.controller.enqueue_key(job.metadata.key)
+            return  # patched object re-enqueues via the watch
 
         changed = helpers.set_condition(
             job.status, JobConditionType.CREATED, reason="JobCreated"
@@ -578,26 +589,31 @@ class TPUJobController:
             self.recorder.event("TPUJob", key, "NodeLost",
                                 f"{pod.metadata.name}: {msg}")
             self.metrics.inc("tpujob.node_lost_pods")
-            for _ in range(3):
-                try:
-                    cur = self.cs.pods(ns).get(pod.metadata.name)
-                except NotFound:
-                    break
+            try:
+                cur = self.cs.pods(ns).get(pod.metadata.name)
                 if (
                     cur.metadata.uid != pod.metadata.uid
                     or cur.status.phase != PodPhase.RUNNING
                 ):
-                    break
-                cur.status.phase = PodPhase.FAILED
-                cur.status.message = msg
-                cur.status.exit_code = None
-                try:
-                    self.cs.pods(ns).update_status(cur)
-                    break
-                except Conflict:
                     continue
-                except NotFound:
-                    break
+                # narrow status patch with an rv PRECONDITION: a pod that
+                # reaches a terminal phase between the get and this write
+                # must not be clobbered to NodeLost — the precondition
+                # turns that race into a skipped write (the periodic node
+                # check re-evaluates)
+                self.cs.pods(ns).patch_status(
+                    pod.metadata.name,
+                    {"metadata": {
+                        "resourceVersion": str(cur.metadata.resource_version)
+                    },
+                     "status": {
+                        "phase": PodPhase.FAILED.value,
+                        "message": msg,
+                        "exitCode": None,
+                    }},
+                )
+            except (Conflict, NotFound):
+                continue
         if running:
             self.controller.enqueue_after(key, NODE_CHECK_PERIOD_S)
 
@@ -966,16 +982,28 @@ class TPUJobController:
             self._write_status(job)
 
     def _write_status(self, job: TPUJob) -> bool:
-        """Returns True when the write landed; False on conflict/deletion
-        (the watch delivers the fresh object and re-enqueues)."""
+        """Returns True when the write landed; False on deletion. Rides the
+        PATCH /status subresource: the controller is the sole owner of job
+        status, so a merge-patch of the full status needs no
+        resourceVersion and can never 409 against concurrent spec writers
+        (scale/suspend/apply) — the happy path is conflict-free."""
+        from tfk8s_tpu.api import serde
+
+        wire_status = serde.to_wire(job.status)
+        # merge-patch can't delete map keys it doesn't mention: a replica
+        # type REMOVED from the spec must carry an explicit null or its
+        # stale replicaStatuses entry survives server-side and every
+        # reconcile re-detects a diff — an endless status-write loop. The
+        # type set is the finite enum, so the nulls are bounded.
+        rs = wire_status.get("replicaStatuses")
+        if isinstance(rs, dict):
+            for rt in ReplicaType:
+                rs.setdefault(rt.value, None)
         try:
-            self.cs.tpujobs(job.metadata.namespace).update_status(job)
+            self.cs.tpujobs(job.metadata.namespace).patch_status(
+                job.metadata.name, {"status": wire_status}
+            )
             return True
-        except Conflict:
-            # Stale copy: the watch will deliver the fresh object and the
-            # controller re-enqueues — the canonical conflict path.
-            self.controller.enqueue_key(job.metadata.key)
-            return False
         except NotFound:
             return False
 
@@ -1040,12 +1068,23 @@ class TPUJobController:
         self._export_capacity_gauges()
         self._prune_evaluator_failures(key)
         if FINALIZER in job.metadata.finalizers:
-            job.metadata.finalizers.remove(FINALIZER)
+            remaining = [f for f in job.metadata.finalizers if f != FINALIZER]
             try:
-                self.cs.tpujobs(job.metadata.namespace).update(job)
+                # stripping the finalizer via PATCH completes the delete
+                # server-side when ours was the last. rv PRECONDITION: the
+                # list replaces wholesale, and completing the delete off a
+                # stale list could drop a foreign finalizer added since —
+                # destroying its owner's chance to ever run cleanup.
+                self.cs.tpujobs(job.metadata.namespace).patch(
+                    job.metadata.name,
+                    {"metadata": {
+                        "resourceVersion": str(job.metadata.resource_version),
+                        "finalizers": remaining,
+                    }},
+                )
             except Conflict:
-                # deletion NOT complete yet — retry without wiping the
-                # event history or recording a premature JobDeleted
+                # deletion NOT complete yet — retry off the fresh object
+                # without wiping event history or recording JobDeleted
                 self.controller.enqueue_key(key)
                 return
             except NotFound:
