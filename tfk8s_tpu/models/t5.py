@@ -254,53 +254,19 @@ def task_for_mesh(
     cfg: Optional[TransformerConfig] = None,
     **task_kw,
 ) -> TrainTask:
-    """Pick the attention impl for the mesh/config. On a sequence-sharded
-    mesh: Ulysses head-all-to-all SP (parallel/ulysses.py) — unlike the
-    ring kernel it supports the [batch, lk] key-padding masks T5's
-    enc-dec attention carries throughout, so T5 long-context rides
-    Ulysses. Otherwise the Pallas flash kernel (also mask-capable,
-    ops/flash_attention.py) on TPU once the sequence crosses
-    FLASH_SEQ_THRESHOLD."""
-    from tfk8s_tpu.ops.flash_attention import auto_flash_attn_fn
-    from tfk8s_tpu.parallel.mesh import AXIS_SEQUENCE
-    from tfk8s_tpu.parallel.ulysses import make_ulysses_attn_fn
+    """Pick the attention impl for the mesh/config via the shared
+    ``transformer.select_attn_fn`` policy. T5's enc-dec attention carries
+    [batch, lk] key-padding masks throughout, and EVERY branch of the
+    shared policy is now mask-capable — including the ring kernel, which
+    rotates the mask block with k/v (parallel/ring_attention.py) — so T5
+    long-context rides Ulysses while the sequence degree divides the
+    per-device head count and ring attention beyond it, like the other
+    families."""
+    from tfk8s_tpu.models.transformer import select_attn_fn
 
     cfg = cfg or base_config()
     seq_len = min(task_kw.get("seq_len", 128), cfg.max_len)
-    seq_sharded = (
-        AXIS_SEQUENCE in mesh.axis_names and mesh.shape[AXIS_SEQUENCE] > 1
-    )
-    if cfg.attention_impl == "ring":
-        raise ValueError(
-            "attention_impl='ring' is not usable for T5: the ring kernel "
-            "carries no key-padding masks and T5's enc-dec attention is "
-            "mask-carrying throughout — use 'ulysses' (or 'auto')"
-        )
-    if cfg.attention_impl == "ulysses" or seq_sharded:
-        if seq_sharded and cfg.attention_impl not in ("auto", "ulysses"):
-            raise ValueError(
-                f"attention_impl={cfg.attention_impl!r} pinned on a "
-                "sequence-sharded mesh; T5 sequence parallelism needs "
-                "'auto' or 'ulysses'"
-            )
-        # Fail at task construction, not at trace time: T5 has no ring
-        # fallback (masks), so its Ulysses degree is hard-capped by the
-        # per-device head count — same check bert.task_for_mesh makes.
-        from tfk8s_tpu.parallel.mesh import AXIS_TENSOR
-
-        h_local = cfg.num_heads // mesh.shape.get(AXIS_TENSOR, 1)
-        sp = mesh.shape.get(AXIS_SEQUENCE, 1)
-        if sp > 1 and h_local % sp:
-            raise ValueError(
-                f"T5 sequence parallelism rides Ulysses head all-to-all, "
-                f"capped by heads: sequence={sp} does not divide the "
-                f"per-device head count {h_local} "
-                f"(= {cfg.num_heads} heads / tensor={mesh.shape.get(AXIS_TENSOR, 1)}); "
-                "lower the sequence degree or raise num_heads"
-            )
-        attn_fn = make_ulysses_attn_fn(mesh)
-    else:
-        attn_fn = auto_flash_attn_fn(cfg.attention_impl, seq_len)
+    attn_fn = select_attn_fn(mesh, cfg, seq_len)
     return make_task(cfg=cfg, attn_fn=attn_fn, **task_kw)
 
 

@@ -437,12 +437,11 @@ def maybe_remat(layer_cls, cfg: TransformerConfig):
 
 
 def select_attn_fn(mesh, cfg: TransformerConfig, seq_len: int):
-    """The mesh-driven attention-impl policy shared by the BERT and GPT
-    families' ``task_for_mesh`` (one copy so their selection cannot
-    drift). T5 deliberately keeps its OWN policy: its enc-dec attention
-    carries key-padding masks, which the ring kernel does not support —
-    routing T5 through this function would silently drop padding masks
-    whenever the head count forces the ring branch (models/t5.py).
+    """The mesh-driven attention-impl policy shared by the BERT, GPT and
+    T5 families' ``task_for_mesh`` (one copy so their selection cannot
+    drift). Every branch is mask-capable — the ring kernel rotates [b, lk]
+    key-padding masks with k/v (parallel/ring_attention.py), so padded and
+    enc-dec batches keep exact SP on every path.
 
     On a sequence-sharded mesh: Ulysses head-all-to-all SP while the
     sequence degree divides the per-device head count, ring attention

@@ -73,10 +73,11 @@ def _pick_bk(lk: int, block_k: Optional[int]) -> int:
     return next((c for c in _BLOCK_K_CANDIDATES if lk % c == 0), lk)
 
 
-def _online_block(qf, q_pos, kt, vt, src, bk, causal, m, l, o):
+def _online_block(qf, q_pos, kt, vt, mt, src, bk, causal, m, l, o):
     """Fold one ring-held K/V block into the online softmax, ``bk``
     columns at a time. Carries: running max ``m`` [b,h,lq], denominator
-    ``l`` [b,h,lq], unnormalized output ``o`` [b,lq,h,d]."""
+    ``l`` [b,h,lq], unnormalized output ``o`` [b,lq,h,d]. ``mt`` is the
+    block's key-validity [b, lk] (float 0/1, rotating with k/v) or None."""
     lk = kt.shape[1]
     nb = lk // bk
 
@@ -89,6 +90,9 @@ def _online_block(qf, q_pos, kt, vt, src, bk, causal, m, l, o):
             k_pos = src * lk + cb * bk + jnp.arange(bk)
             cm = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(cm[None, None], s, _NEG)
+        if mt is not None:
+            ms = lax.dynamic_slice_in_dim(mt, cb * bk, bk, 1)  # [b, bk]
+            s = jnp.where(ms[:, None, None, :] > 0, s, _NEG)
         blk_max = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - m_new)
@@ -103,7 +107,9 @@ def _online_block(qf, q_pos, kt, vt, src, bk, causal, m, l, o):
     return m, l, o
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, block_k):
+def _ring_fwd_impl(q, k, v, mask, axis_name, causal, block_k):
+    """``mask`` is the LOCAL key-validity block [b, lk] as float 0/1 (or
+    None); it rotates around the ring with its k/v block."""
     ring = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -117,34 +123,42 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, block_k):
     l0 = jnp.zeros((b, h, lq), jnp.float32)
     o0 = jnp.zeros((b, lq, h, d), jnp.float32)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
+    # one loop body for both paths: the rotating operands are a tuple —
+    # (k, v) or (k, v, mask) — so the maskless path carries no dummy
+    # traffic and the ring schedule exists in exactly one place
+    blocks0 = (k, v) if mask is None else (k, v, mask)
 
     def body(t, carry):
-        m, l, o, kt, vt = carry
+        m, l, o, blocks = carry
+        kt, vt = blocks[:2]
+        mt = blocks[2] if len(blocks) == 3 else None
         # block now held originated on shard (me - t) mod ring
         m, l, o = _online_block(
-            qf, q_pos, kt, vt, (me - t) % ring, bk, causal, m, l, o
+            qf, q_pos, kt, vt, mt, (me - t) % ring, bk, causal, m, l, o
         )
-        return (
-            m, l, o,
-            lax.ppermute(kt, axis_name, perm),
-            lax.ppermute(vt, axis_name, perm),
-        )
+        rotated = tuple(lax.ppermute(x, axis_name, perm) for x in blocks)
+        return (m, l, o, rotated)
 
     # ring-1 rotate+process iterations; the final held block needs no
     # outgoing permute (it would be dead traffic on ICI)
-    m, l, o, kt, vt = lax.fori_loop(0, ring - 1, body, (m0, l0, o0, k, v))
+    m, l, o, blocks = lax.fori_loop(0, ring - 1, body, (m0, l0, o0, blocks0))
     m, l, o = _online_block(
-        qf, q_pos, kt, vt, (me - (ring - 1)) % ring, bk, causal, m, l, o
+        qf, q_pos, blocks[0], blocks[1],
+        blocks[2] if len(blocks) == 3 else None,
+        (me - (ring - 1)) % ring, bk, causal, m, l, o,
     )
     # fully-masked rows (causal, early ring slots) have l == 0 per block,
-    # but after the full ring every query row has seen its own position
+    # but after the full ring every query row has seen its own position.
+    # (Fully PADDED query rows keep the uniform-weight garbage the
+    # unsharded softmax reference also produces — downstream loss masking
+    # owns those rows.)
     l_safe = jnp.maximum(l, 1e-30)
     out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
     lse = m + jnp.log(l_safe)  # [b, h, lq] — the only O(L) residual
     return out, lse
 
 
-def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k):
+def _ring_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal, block_k):
     ring = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -158,7 +172,7 @@ def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k):
     dvec = jnp.sum(do * out.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
 
-    def block_grads(kt, vt, src):
+    def block_grads(kt, vt, mt, src):
         """dq contribution of the held block, plus the block's own
         (dk, dv) — each k column's gradient depends only on this device's
         queries within this ring step, so chunks stack cleanly."""
@@ -168,11 +182,27 @@ def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k):
             ks = lax.dynamic_slice_in_dim(kt, cb * bk, bk, 1).astype(jnp.float32)
             vs = lax.dynamic_slice_in_dim(vt, cb * bk, bk, 1).astype(jnp.float32)
             s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks)
+            allowed = None
             if causal:
                 k_pos = src * lk + cb * bk + jnp.arange(bk)
-                cm = q_pos[:, None] >= k_pos[None, :]
-                s = jnp.where(cm[None, None], s, _NEG)
+                allowed = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            if mt is not None:
+                ms = lax.dynamic_slice_in_dim(mt, cb * bk, bk, 1)
+                vm = ms[:, None, None, :] > 0
+                allowed = vm if allowed is None else jnp.logical_and(allowed, vm)
+            if allowed is not None:
+                # mask s BEFORE the exp: an unmasked raw score against the
+                # degenerate lse of a fully-padded query row (~ -1e30)
+                # would overflow exp to inf
+                s = jnp.where(allowed, s, _NEG)
             p = jnp.exp(s - lse[..., None])  # masked: exp(_NEG - lse) = 0
+            if mt is not None:
+                # degenerate rows (zero visible keys) have lse ≈ _NEG, so
+                # even masked entries give exp(_NEG - lse) = 1/L, not 0 —
+                # select-zero them exactly, the same where-guard as
+                # ops/flash_attention.py's backward (causal folded into
+                # ``allowed`` so causally-forbidden entries die too)
+                p = jnp.where(allowed, p, 0.0)
             dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, do)
             dp = jnp.einsum("bqhd,bkhd->bhqk", do, vs)
             ds = p * (dp - dvec[..., None])
@@ -188,29 +218,36 @@ def _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k):
         dv_b = jnp.moveaxis(dv_st, 0, 1).reshape(b, lk, h, d)
         return dq_c, dk_b, dv_b
 
+    zeros_kv = jnp.zeros((b, lk, h, d), jnp.float32)
+    blocks0 = (k, v) if mask is None else (k, v, mask)
+
     def body(t, carry):
-        dq, kt, vt, dk, dv = carry
-        dq_c, dk_b, dv_b = block_grads(kt, vt, (me - t) % ring)
+        dq, blocks, dk, dv = carry
+        kt, vt = blocks[:2]
+        mt = blocks[2] if len(blocks) == 3 else None
+        dq_c, dk_b, dv_b = block_grads(kt, vt, mt, (me - t) % ring)
         # dk/dv ride the SAME rotation as k/v: after the full ring each
         # block's accumulator has collected every device's contribution
         # and is back home (ring ppermutes = identity)
+        rotated = tuple(lax.ppermute(x, axis_name, perm) for x in blocks)
         return (
             dq + dq_c,
-            lax.ppermute(kt, axis_name, perm),
-            lax.ppermute(vt, axis_name, perm),
+            rotated,
             lax.ppermute(dk + dk_b, axis_name, perm),
             lax.ppermute(dv + dv_b, axis_name, perm),
         )
 
-    zeros_kv = jnp.zeros((b, lk, h, d), jnp.float32)
-    dq, kt, vt, dk, dv = lax.fori_loop(
+    dq, blocks, dk, dv = lax.fori_loop(
         0, ring - 1,
         body,
-        (jnp.zeros((b, lq, h, d), jnp.float32), k, v, zeros_kv, zeros_kv),
+        (jnp.zeros((b, lq, h, d), jnp.float32), blocks0, zeros_kv, zeros_kv),
     )
     # final block: k/v get no outgoing permute (dead ICI traffic, same as
     # the forward); dk/dv take their ring-th hop home
-    dq_c, dk_b, dv_b = block_grads(kt, vt, (me - (ring - 1)) % ring)
+    dq_c, dk_b, dv_b = block_grads(
+        blocks[0], blocks[1], blocks[2] if len(blocks) == 3 else None,
+        (me - (ring - 1)) % ring,
+    )
     dq = dq + dq_c
     dk = lax.ppermute(dk + dk_b, axis_name, perm)
     dv = lax.ppermute(dv + dv_b, axis_name, perm)
@@ -223,15 +260,41 @@ def _make_local_attn(axis_name: str, causal: bool, block_k: Optional[int]):
 
     @jax.custom_vjp
     def attn(q, k, v):
-        return _ring_fwd_impl(q, k, v, axis_name, causal, block_k)[0]
+        return _ring_fwd_impl(q, k, v, None, axis_name, causal, block_k)[0]
 
     def fwd(q, k, v):
-        out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, block_k)
+        out, lse = _ring_fwd_impl(q, k, v, None, axis_name, causal, block_k)
         return out, (q, k, v, out, lse)
 
     def bwd(res, g):
         q, k, v, out, lse = res
-        return _ring_bwd_impl(q, k, v, out, lse, g, axis_name, causal, block_k)
+        return _ring_bwd_impl(
+            q, k, v, None, out, lse, g, axis_name, causal, block_k
+        )
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def _make_local_attn_masked(axis_name: str, causal: bool, block_k: Optional[int]):
+    """Masked variant: the mask is a float 0/1 [b, lk] traced argument
+    (float so custom_vjp has a well-typed — identically zero — cotangent
+    slot for it)."""
+
+    @jax.custom_vjp
+    def attn(q, k, v, mask):
+        return _ring_fwd_impl(q, k, v, mask, axis_name, causal, block_k)[0]
+
+    def fwd(q, k, v, mask):
+        out, lse = _ring_fwd_impl(q, k, v, mask, axis_name, causal, block_k)
+        return out, (q, k, v, mask, out, lse)
+
+    def bwd(res, g):
+        q, k, v, mask, out, lse = res
+        dq, dk, dv = _ring_bwd_impl(
+            q, k, v, mask, out, lse, g, axis_name, causal, block_k
+        )
+        return dq, dk, dv, jnp.zeros_like(mask)
 
     attn.defvjp(fwd, bwd)
     return attn
@@ -245,26 +308,49 @@ def make_ring_attn_fn(
     """Build an ``attn_fn(q, k, v, mask=None, causal=False)`` that runs
     ring attention with batch over data(+fsdp), heads over tensor, and
     sequence over ``seq_axis``. ``block_k`` sets the inner chunk width
-    (None = largest of 512/256/128 dividing the local block). Requires
-    mask=None (padding masks would need per-block mask rotation —
-    synthetic pretraining data is unpadded)."""
+    (None = largest of 512/256/128 dividing the local block).
+
+    ``mask`` may be a [b, L] key-validity mask (bool or 0/1): it is
+    sequence-sharded like k/v and each local block ROTATES around the
+    ring with its k/v block, so padded/packed batches keep exact SP —
+    they no longer have to fall back to full attention. (Full [q, k]
+    masks are not supported: their rows are query-sharded AND their
+    columns key-sharded, which the ring layout cannot carry.)"""
+    if seq_axis not in mesh.axis_names:
+        # fail at construction with the fix, not at trace time with a
+        # shard_map unknown-axis error (same contract as ulysses.py)
+        raise ValueError(
+            f"ring attention needs a {seq_axis!r} axis on the mesh; this "
+            f"mesh has {tuple(mesh.axis_names)} — add sequence=N to the "
+            "job's MeshSpec (or drop the explicit 'ring' pin)"
+        )
     batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
     head_axis = AXIS_TENSOR if AXIS_TENSOR in mesh.axis_names else None
     bspec = batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None)
     spec = P(bspec, seq_axis, head_axis, None)
 
     def attn_fn(q, k, v, mask=None, causal=False):
-        if mask is not None:
+        if mask is None:
+            inner = shard_map(
+                _make_local_attn(seq_axis, causal, block_k),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            return inner(q, k, v)
+        if mask.ndim != 2:
             raise NotImplementedError(
-                "ring attention: padding masks not supported; pass mask=None"
+                "ring attention: only 2-D [batch, key_len] key-padding "
+                f"masks are supported; got mask.ndim={mask.ndim}"
             )
         inner = shard_map(
-            _make_local_attn(seq_axis, causal, block_k),
+            _make_local_attn_masked(seq_axis, causal, block_k),
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=(spec, spec, spec, P(bspec, seq_axis)),
             out_specs=spec,
             check_vma=False,
         )
-        return inner(q, k, v)
+        return inner(q, k, v, mask.astype(jnp.float32))
 
     return attn_fn
