@@ -55,10 +55,18 @@ def test_crc32c_python_matches_native(force_pure_py):
         assert int(lib.rio_crc32c(data, len(data))) == py
 
 
-def test_native_library_builds_on_this_rig():
-    """The image ships g++ — the native core must actually build here
-    (elsewhere the fallback is legitimate; on this rig a silent fallback
-    would hide a build break)."""
+def test_native_library_builds_when_toolchain_present():
+    """Where g++ exists the native core must actually build — a fallback
+    there is a build break, not a missing toolchain. On toolchain-less
+    machines the pure-Python fallback is legitimate, so the assertion is
+    skipped (TFK8S_REQUIRE_NATIVE=1 forces it regardless, for images
+    whose contract includes the native reader)."""
+    import shutil
+
+    if shutil.which("g++") is None and os.environ.get(
+        "TFK8S_REQUIRE_NATIVE"
+    ) != "1":
+        pytest.skip("no g++ on this machine; pure-Python fallback is the contract")
     assert _native.load() is not None
 
 
@@ -409,3 +417,74 @@ def test_trainer_files_input_composes_with_grad_accum(tmp_path):
     )
     _state, history = trainer.fit()
     assert np.isfinite(history[-1]["loss"])
+
+
+def test_pure_python_fallback_warns_loudly(tmp_path, monkeypatch, caplog):
+    """VERDICT r4 weak #3: reading through the pure-Python codec is an
+    input-bandwidth outage (~120x) and must say so — once — unless the
+    operator opted out explicitly with TFK8S_PURE_PY=1."""
+    import logging
+
+    from tfk8s_tpu.data import recordio
+
+    path = str(tmp_path / "w.rio")
+    with RecordWriter(path) as w:
+        w.write(b"payload")
+
+    monkeypatch.setattr(_native, "load", lambda: None)
+    monkeypatch.delenv("TFK8S_PURE_PY", raising=False)
+    monkeypatch.setattr(recordio, "_fallback_warned", False)
+    with caplog.at_level(logging.WARNING, logger="tfk8s.data.recordio"):
+        RecordFile(path)
+        RecordFile(path)  # second open: no second warning
+    warns = [r for r in caplog.records if "pure-Python codec" in r.message]
+    assert len(warns) == 1, [r.message for r in caplog.records]
+
+    # deliberate opt-out stays quiet
+    monkeypatch.setenv("TFK8S_PURE_PY", "1")
+    monkeypatch.setattr(recordio, "_fallback_warned", False)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="tfk8s.data.recordio"):
+        RecordFile(path)
+    assert not [r for r in caplog.records if "pure-Python" in r.message]
+
+
+def test_failed_native_build_warns_with_stderr(tmp_path, monkeypatch, caplog):
+    """A PRESENT g++ that fails to compile is a broken build — the
+    warning must carry the compiler's stderr, not vanish (ADVICE r4)."""
+    import logging
+    import subprocess as sp
+
+    def fake_run(cmd, **kw):
+        raise sp.CalledProcessError(1, cmd, stderr=b"fatal error: boom")
+
+    monkeypatch.setattr(_native, "_tried", False)
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setenv("TFK8S_NATIVE_CACHE", str(tmp_path / "fresh-cache"))
+    monkeypatch.setattr(_native.subprocess, "run", fake_run)
+    with caplog.at_level(logging.WARNING, logger="tfk8s.data.native"):
+        assert _native.load() is None
+    msgs = [r.message for r in caplog.records]
+    assert any("boom" in m for m in msgs), msgs
+    # un-latch so later tests get the real library again
+    monkeypatch.setattr(_native, "_tried", False)
+
+
+def test_dataset_reports_bytes_read(tmp_path):
+    """The input-bandwidth counter the trainer's progress report
+    differences into input_mb_per_sec."""
+    from tfk8s_tpu.data import encode
+    from tfk8s_tpu.data.dataset import RecordDataset
+
+    path = str(tmp_path / "b.rio")
+    with RecordWriter(path) as w:
+        for i in range(8):
+            w.write(encode({"x": np.full((4,), i, np.int32)}))
+    ds = RecordDataset([path], batch_size=4, shuffle=False)
+    assert ds.bytes_read == 0
+    it = ds.iterator(prefetch=0)
+    next(it)
+    first = ds.bytes_read
+    assert first > 0
+    next(it)
+    assert ds.bytes_read > first

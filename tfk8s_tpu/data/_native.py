@@ -13,11 +13,14 @@ from __future__ import annotations
 
 import ctypes
 import hashlib
+import logging
 import os
 import subprocess
 import tempfile
 import threading
 from typing import Optional
+
+log = logging.getLogger("tfk8s.data.native")
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "native", "recordio.cc")
@@ -51,7 +54,34 @@ def _build() -> Optional[str]:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
         return out
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+    except FileNotFoundError:
+        # no toolchain at all — the legitimate quiet-fallback case
+        # (laptops, minimal containers); recordio.py logs the consequence
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    except subprocess.CalledProcessError as e:
+        # a PRESENT g++ that fails is a broken build, not a missing
+        # toolchain — say so with the compiler's own words (the silent
+        # version of this cost 120x input bandwidth with empty logs)
+        log.warning(
+            "native recordio build FAILED (g++ rc=%s); falling back to the "
+            "pure-Python codec (~120x slower reads). stderr:\n%s",
+            e.returncode,
+            (e.stderr or b"").decode(errors="replace")[-2000:],
+        )
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    except (subprocess.SubprocessError, OSError) as e:
+        log.warning(
+            "native recordio build errored (%s: %s); falling back to the "
+            "pure-Python codec (~120x slower reads)", type(e).__name__, e,
+        )
         try:
             os.unlink(tmp)
         except OSError:

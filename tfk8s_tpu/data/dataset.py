@@ -79,6 +79,7 @@ class RecordDataset:
         self.decode = decode
         self.drop_remainder = drop_remainder
         self.verify_crc = verify_crc
+        self.bytes_read = 0  # cumulative payload bytes (input-rate metric)
         self._shards = [RecordFile(p) for p in self.files]
         # global record addressing: (shard_idx, record_idx) pairs
         self._addr: List[Tuple[int, int]] = [
@@ -153,6 +154,12 @@ class RecordDataset:
             si: self._shards[si].read(ris, verify=self.verify_crc)
             for si, ris in by_shard.items()
         }
+        # input-bandwidth accounting: consumers (the trainer's windowed
+        # progress report) difference this to surface read MB/s — an
+        # operator alert can then SEE input starvation (e.g. the ~120x
+        # pure-Python codec fallback) instead of inferring it from step
+        # time
+        self.bytes_read += sum(sum(len(r) for r in rs) for rs in raw.values())
         examples = [self.decode(raw[si][pos]) for si, pos in slots]
         keys = examples[0].keys()
         for ex in examples[1:]:

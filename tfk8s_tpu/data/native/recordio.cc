@@ -113,7 +113,8 @@ uint32_t rio_masked_crc32c(const uint8_t* data, int64_t n) {
 // Scan a record file, verifying every header CRC. On success returns the
 // record count and malloc'd arrays (caller frees via rio_free) of each
 // record's DATA offset and length. Negative return = error:
-//   -1 open failed, -2 truncated frame, -3 header CRC mismatch.
+//   -1 open failed, -2 truncated frame, -3 header CRC mismatch,
+//   -5 out of memory growing the index.
 int64_t rio_index(const char* path, int64_t** offsets, int64_t** lengths) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
@@ -132,6 +133,12 @@ int64_t rio_index(const char* path, int64_t** offsets, int64_t** lengths) {
   int64_t cap = 1024, n = 0;
   int64_t* offs = (int64_t*)malloc(cap * sizeof(int64_t));
   int64_t* lens = (int64_t*)malloc(cap * sizeof(int64_t));
+  if (!offs || !lens) {
+    free(offs);
+    free(lens);
+    fclose(f);
+    return -5;
+  }
   uint8_t hdr[12];
   int64_t rc = 0;
   for (;;) {
@@ -149,8 +156,15 @@ int64_t rio_index(const char* path, int64_t** offsets, int64_t** lengths) {
     if (off + (int64_t)len + 4 > fsize) { rc = -2; break; }  // truncated body
     if (n == cap) {
       cap *= 2;
-      offs = (int64_t*)realloc(offs, cap * sizeof(int64_t));
-      lens = (int64_t*)realloc(lens, cap * sizeof(int64_t));
+      // checked growth: a failed realloc returns NULL and LEAVES the old
+      // block valid — assigning unchecked would both leak it and crash on
+      // the next store
+      int64_t* no = (int64_t*)realloc(offs, cap * sizeof(int64_t));
+      if (!no) { rc = -5; break; }
+      offs = no;
+      int64_t* nl = (int64_t*)realloc(lens, cap * sizeof(int64_t));
+      if (!nl) { rc = -5; break; }
+      lens = nl;
     }
     offs[n] = off;
     lens[n] = (int64_t)len;
