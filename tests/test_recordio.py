@@ -466,8 +466,8 @@ def test_failed_native_build_warns_with_stderr(tmp_path, monkeypatch, caplog):
         assert _native.load() is None
     msgs = [r.message for r in caplog.records]
     assert any("boom" in m for m in msgs), msgs
-    # un-latch so later tests get the real library again
-    monkeypatch.setattr(_native, "_tried", False)
+    # monkeypatch teardown restores _tried/_lib to their pre-test values,
+    # so later tests rebind the real library automatically
 
 
 def test_dataset_reports_bytes_read(tmp_path):

@@ -1,0 +1,261 @@
+"""Control-plane benchmark — the half of the system the reference
+actually documents (VERDICT r4 weak #6 / next #5).
+
+The reference's stated hot loop is the worker dequeue loop — "the loop
+the whole system's latency hangs off" (SURVEY.md §3.2,
+k8s-operator.md:175-180). This harness measures it hermetically (pure
+CPU, no tunnel, no TPU): N TPUJobs with their pods churning against the
+real store + informer + workqueue + controller machinery, plus the raw
+substrate rates underneath. Emitted as the ``control_plane`` block of
+bench.py's JSON line and recorded in BASELINE.md.
+
+Sections:
+
+- **store**: raw CRUD rates — creates/s, status-PATCH/s, and the same
+  with the WAL journal on (fsync off: page-cache durability, the kill -9
+  contract; fsync cost is device-dependent and measured separately when
+  it matters);
+- **watch fanout**: one writer updating an object stream against W
+  concurrent watchers — delivered events/s total;
+- **reconcile**: submit N gang jobs against the full informer →
+  workqueue → controller loop with an instant-Running node agent;
+  jobs/s to the Running condition, per-job submit→Running latency
+  p50/p99, peak workqueue depth.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import tempfile
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def _make_job(name: str):
+    from tfk8s_tpu.api.types import (
+        ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, RunPolicy,
+        SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
+    )
+
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2,
+                    template=ContainerSpec(entrypoint="bench:noop"),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+            run_policy=RunPolicy(scheduling=SchedulingPolicy(gang=True)),
+        ),
+    )
+
+
+def bench_store(n_writes: int) -> Dict[str, float]:
+    from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client.store import ClusterStore
+
+    out: Dict[str, float] = {}
+
+    def one(store, tag):
+        t0 = time.perf_counter()
+        for i in range(n_writes):
+            store.create(_make_job(f"{tag}-{i:05d}"))
+        out[f"{tag}_creates_per_s"] = round(n_writes / (time.perf_counter() - t0), 1)
+        status = serde.to_wire(_make_job("x"))["status"]
+        t0 = time.perf_counter()
+        for i in range(n_writes):
+            store.patch(
+                "TPUJob", "default", f"{tag}-{i:05d}",
+                {"status": status}, subresource="status",
+            )
+        out[f"{tag}_status_patches_per_s"] = round(
+            n_writes / (time.perf_counter() - t0), 1
+        )
+
+    one(ClusterStore(), "memory")
+    with tempfile.TemporaryDirectory(prefix="cpbench-journal-") as d:
+        one(ClusterStore(journal_dir=d, fsync=False), "journal")
+    return out
+
+
+def bench_watch_fanout(watchers: int, updates: int) -> Dict[str, float]:
+    from tfk8s_tpu.client.store import ClusterStore
+
+    store = ClusterStore()
+    store.create(_make_job("fan"))
+    counts = [0] * watchers
+    done = threading.Event()
+    ws = [store.watch("TPUJob") for _ in range(watchers)]
+
+    def drain(i, w):
+        while counts[i] < updates:
+            if w.next(timeout=5.0) is None:
+                break
+            counts[i] += 1
+        if all(c >= updates for c in counts):
+            done.set()
+
+    threads = [
+        threading.Thread(target=drain, args=(i, w), daemon=True)
+        for i, w in enumerate(ws)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    cur = store.get("TPUJob", "default", "fan")
+    for _ in range(updates):
+        cur.status.gang_restarts += 1
+        cur = store.update_status(cur)
+    done.wait(timeout=60)
+    dt = time.perf_counter() - t0
+    for w in ws:
+        store.stop_watch(w)
+    delivered = sum(counts)
+    return {
+        "watchers": watchers,
+        "updates": updates,
+        "delivered_events_per_s": round(delivered / dt, 1),
+        "complete": all(c >= updates for c in counts),
+    }
+
+
+class _InstantKubelet:
+    """Marks every PENDING pod Running immediately — isolates the
+    control-plane path (informer → queue → reconcile → status write)
+    from any data-plane work."""
+
+    def __init__(self, cs):
+        self.cs = cs
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        from tfk8s_tpu.api.types import PodPhase
+        from tfk8s_tpu.client.store import Conflict, NotFound
+
+        w = self.cs.pods("default").watch()
+        while not self._stop.is_set():
+            ev = w.next(timeout=0.5)
+            if ev is None:
+                continue
+            pod = ev.object
+            if pod.status.phase != PodPhase.PENDING:
+                continue
+            try:
+                cur = self.cs.pods("default").get(pod.metadata.name)
+                if cur.status.phase != PodPhase.PENDING:
+                    continue
+                cur.status.phase = PodPhase.RUNNING
+                cur.status.host = "bench-node"
+                self.cs.pods("default").update_status(cur)
+            except (Conflict, NotFound):
+                continue
+
+
+def bench_reconcile(n_jobs: int) -> Dict[str, float]:
+    from tfk8s_tpu.api import helpers
+    from tfk8s_tpu.api.types import JobConditionType
+    from tfk8s_tpu.client.fake import FakeClientset
+    from tfk8s_tpu.trainer.gang import SliceAllocator
+    from tfk8s_tpu.trainer.tpujob_controller import TPUJobController
+
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator(None))
+    kubelet = _InstantKubelet(cs)
+    stop = threading.Event()
+    depth_samples: List[int] = []
+    depth_stop = threading.Event()
+
+    def sample_depth():
+        q = ctrl.controller.queue
+        while not depth_stop.is_set():
+            depth_samples.append(len(q))
+            time.sleep(0.002)
+
+    kubelet.start()
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    sampler = threading.Thread(target=sample_depth, daemon=True)
+    sampler.start()
+    submit_t: Dict[str, float] = {}
+    running_t: Dict[str, float] = {}
+    try:
+        jobs_w = cs.store.watch("TPUJob")
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            name = f"cp-{i:04d}"
+            cs.tpujobs("default").create(_make_job(name))
+            submit_t[name] = time.perf_counter()
+        deadline = time.time() + max(60, n_jobs)
+        while len(running_t) < n_jobs and time.time() < deadline:
+            ev = jobs_w.next(timeout=5.0)
+            if ev is None:
+                continue
+            job = ev.object
+            name = job.metadata.name
+            if name not in running_t and helpers.has_condition(
+                job.status, JobConditionType.RUNNING
+            ):
+                running_t[name] = time.perf_counter()
+        dt = time.perf_counter() - t0
+        cs.store.stop_watch(jobs_w)
+    finally:
+        depth_stop.set()
+        kubelet.stop()
+        stop.set()
+        ctrl.controller.shutdown()
+    lats = sorted(
+        running_t[n] - submit_t[n] for n in running_t if n in submit_t
+    )
+    if not lats:
+        return {"jobs": n_jobs, "complete": False}
+    return {
+        "jobs": n_jobs,
+        "complete": len(lats) == n_jobs,
+        "jobs_per_s_to_running": round(len(lats) / dt, 1),
+        "submit_to_running_p50_ms": round(
+            statistics.median(lats) * 1000, 1
+        ),
+        "submit_to_running_p99_ms": round(
+            float(np.quantile(lats, 0.99)) * 1000, 1
+        ),
+        "workqueue_depth_max": max(depth_samples) if depth_samples else 0,
+        "workqueue_depth_mean": round(
+            statistics.mean(depth_samples), 2
+        ) if depth_samples else 0.0,
+    }
+
+
+def run_all(small: bool = False) -> Dict[str, object]:
+    n_writes = 200 if small else 2000
+    watchers = 4 if small else 16
+    updates = 100 if small else 1000
+    n_jobs = 8 if small else 64
+    return {
+        "small": small,
+        **bench_store(n_writes),
+        "watch_fanout": bench_watch_fanout(watchers, updates),
+        "reconcile": bench_reconcile(n_jobs),
+    }
+
+
+def main() -> None:
+    import json
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    print(json.dumps({"control_plane": run_all(small=small)}))
+
+
+if __name__ == "__main__":
+    main()
