@@ -211,26 +211,43 @@ def _flash_speedup(seq: int = 2048, iters: int = 8, blocks=None,
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
         grad = jax.grad(loss, argnums=(0, 1, 2))
+        # ALL three grads feed the scan carry (body_kv below) — leaving
+        # dk/dv out of the dependency chain would let XLA dead-code-
+        # eliminate the dkv half of the backward and undercount the work.
+        # DIFFERENCED timing (same method as tools/roofline.py): one
+        # window through the tunnel costs a fixed ~50-110 ms round trip
+        # on top of the device work, so a single-length window reports
+        # fixed + work and UNDERSTATES any speedup — at seq 8192 / b1h4
+        # the r4 artifact recorded 1.16x where the marginal-cost truth is
+        # ~4x. Timing two scan lengths and differencing cancels the fixed
+        # cost exactly; median-of-3 windows each side keeps the noise
+        # floor below the 4*iters marginal iterations being measured.
+        def window_of(n):
+            # k/v ride as jit ARGUMENTS — closing over the device arrays
+            # would bake ~48 MB of constants into each HLO, and the
+            # differenced method doubles the compile count (the round-1
+            # remote-compile 413 failure mode the autotune comment
+            # documents)
+            def _scan(q, k, v):
+                def body_kv(c, _):
+                    dq, dk, dv = grad(c, k, v)
+                    return c + 0.0 * (dq + dk + dv).astype(c.dtype), ()
 
-        def body(c, _):
-            # ALL three grads feed the carry — leaving dk/dv out of the
-            # dependency chain lets XLA dead-code-eliminate the dkv half
-            # of the backward, which would undercount the timed work.
-            dq, dk, dv = grad(c, k, v)
-            chain = (dq + dk + dv).astype(c.dtype)
-            return c + 0.0 * chain, ()  # chain the iterations
+                return jax.lax.scan(body_kv, q, None, length=n)[0]
 
-        run = jax.jit(
-            lambda q: jax.lax.scan(body, q, None, length=iters)[0]
-        )
-        out = run(q)
-        float(np.asarray(out[0, 0, 0, 0]))  # compile + warm (host barrier)
+            run = jax.jit(_scan)
+            out = run(q, k, v)
+            float(np.asarray(out[0, 0, 0, 0]))  # compile + warm (host barrier)
 
-        def timed_once():
-            out = run(q)
-            float(np.asarray(out[0, 0, 0, 0]))
+            def timed_once():
+                out = run(q, k, v)
+                float(np.asarray(out[0, 0, 0, 0]))
 
-        return _median_window(timed_once)[0] / iters * 1000
+            return _median_window(timed_once)[0]
+
+        t1 = window_of(iters)
+        t2 = window_of(5 * iters)
+        return (t2 - t1) / (4 * iters) * 1000
 
     return time_one(flash_attention), time_one(dot_product_attention)
 
@@ -335,7 +352,17 @@ def _gpt_decode_ms_per_token(small: bool, batch: Optional[int] = None):
     def timed_once():
         np.asarray(run(params, prompt))
 
-    sec, windows = _median_window(timed_once)
+    # the serving rows were the noisiest in r4 (34% window spread where
+    # the fit rows hold ±2% — VERDICT r4 weak #4): each window is ONE
+    # ~1s generation, so a single tunnel stall dominates it. Two fixes:
+    # more windows (7 vs 3), and one SETTLE generation after the compile
+    # warmup — the first post-warmup window measures reproducibly ~25%
+    # faster than steady state (r4: 0.977 vs ~1.30; r5: 0.938 vs ~1.26;
+    # dispatch pipelining against the still-warm device queue), so it
+    # belongs to warmup, not to the serving rate being reported.
+    timed_once()  # settle: absorb the fast first window
+    n_win = int(os.environ.get("BENCH_DECODE_WINDOWS", "3" if small else "7"))
+    sec, windows = _median_window(timed_once, windows=n_win)
     # generation runs ONE batched-prefill dispatch (prompt-parallel
     # matmuls) + num_tokens decode steps; ms_per_token divides the
     # END-TO-END time by GENERATED tokens (prefill cost amortized in),
@@ -396,6 +423,12 @@ def _recordio_probe(small: bool):
         py_slice = idx[: max(len(idx) // 16, 1)]
         py_bytes = sum(rf.lengths[i] for i in py_slice)
         try:
+            # deliberate measurement of the fallback, not an outage —
+            # pre-latch the once-per-process warning so the bench log
+            # doesn't cry wolf about a native reader that IS available
+            from tfk8s_tpu.data import recordio as _rio
+
+            _rio._fallback_warned = True
             _native._tried, _native._lib, saved = True, None, _native._lib
             py_rf = RecordFile(path)
             t0 = time.perf_counter()
@@ -582,7 +615,7 @@ def main() -> None:
     # -- flash-attention win at long sequence (VERDICT r2 #4): autotuned
     # blocks, plus a REAL long-context model row (BERT seq-2048, flash)
     flash_ms = xla_ms = mflash_ms = mxla_ms = f8k_ms = x8k_ms = None
-    flash_blocks = None
+    flash_blocks = f8k_blocks = None
     bert2k_sec = None
     if not small and os.environ.get("BENCH_FLASH", "1") == "1":
         try:
@@ -612,8 +645,22 @@ def main() -> None:
             try:
                 from tfk8s_tpu.ops.flash_attention import pick_blocks as _pb
 
-                lblocks = _pb(8192)
+                # autotune AT the 8192 geometry (VERDICT r4 weak #1: r4
+                # reused blocks tuned at 2048 — the one length where the
+                # [L, L] buffer actually hurts was measured with a 4x
+                # shorter length's winner). Candidates skewed to larger
+                # tiles: at L=8192 the per-tile compute amortizes better
+                # and the scores row is the VMEM pressure, not [bq, bk].
+                l_tuned = autotune_blocks(
+                    8192, batch=1, heads=4, iters=2,
+                    candidates=[
+                        (512, 512), (1024, 512), (1024, 1024),
+                        (512, 1024), (256, 512),
+                    ],
+                )
+                lblocks = l_tuned[:2] if l_tuned else _pb(8192)
                 if lblocks is not None:
+                    f8k_blocks = tuple(lblocks)
                     f8k_ms, x8k_ms = _flash_speedup(
                         seq=8192, iters=4, blocks=lblocks, b=1, h=4
                     )
@@ -887,6 +934,7 @@ def main() -> None:
                             "flash_attn_seq8192_speedup": round(
                                 x8k_ms / f8k_ms, 3
                             ),
+                            "flash_blocks_seq8192": list(f8k_blocks),
                         }
                         if f8k_ms
                         else {}
