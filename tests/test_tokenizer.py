@@ -1,0 +1,181 @@
+"""Text-level serving loop (VERDICT r4 next #10): self-contained BPE
+tokenizer + corpus packing + fine-tune from text shards + decode back to
+text — the whole path a reference user walks from raw text to a serving
+model, with zero downloads."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tfk8s_tpu.data.tokenizer import BPETokenizer, bytes_to_unicode, train_bpe
+from tfk8s_tpu.data import corpus as corpus_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog. " * 30,
+    "pack my box with five dozen liquor jugs, judge! " * 30,
+    "sphinx of black quartz: judge my vow. " * 30,
+]
+
+
+class TestBPETokenizer:
+    def test_byte_table_is_the_gpt2_constant(self):
+        table = bytes_to_unicode()
+        assert len(table) == 256
+        assert len(set(table.values())) == 256  # bijective
+        assert table[ord("A")] == "A"  # printable ascii maps to itself
+        assert table[0] == chr(256)  # first non-printable relabelled
+
+    def test_roundtrip_lossless_any_text(self):
+        tok = train_bpe(TEXTS, vocab_size=400)
+        for text in [
+            "the quick brown fox",
+            "héllo wörld — ünïcode 🙂",
+            "tabs\tand\nnewlines  and   spaces",
+            "NEVER-seen Symbols ¤µ 12345!?",
+        ]:
+            assert tok.decode(tok.encode(text)) == text
+
+    def test_training_compresses_the_corpus(self):
+        tok = train_bpe(TEXTS, vocab_size=500, specials=["<|pad|>"])
+        ids = tok.encode("the quick brown fox jumps over the lazy dog.")
+        # trained merges must beat byte-level (44 bytes) by a wide margin
+        assert len(ids) < 20, len(ids)
+        # specials get the LOW stable ids regardless of corpus
+        assert tok.vocab["<|pad|>"] == 0
+
+    def test_save_load_hf_layout(self, tmp_path):
+        tok = train_bpe(TEXTS, vocab_size=400, specials=["<|pad|>"])
+        tok.save(str(tmp_path))
+        assert (tmp_path / "vocab.json").exists()
+        assert (tmp_path / "merges.txt").exists()
+        tok2 = BPETokenizer.load(str(tmp_path))
+        probe = "judge my vow, quick fox"
+        assert tok2.encode(probe) == tok.encode(probe)
+        assert tok2.decode(tok2.encode(probe)) == probe
+
+    def test_deterministic_training(self):
+        a = train_bpe(TEXTS, vocab_size=350)
+        b = train_bpe(TEXTS, vocab_size=350)
+        assert a.merges == b.merges
+        assert a.vocab == b.vocab
+
+
+class TestCorpusPacking:
+    def test_cli_packs_shards(self, tmp_path):
+        cdir = tmp_path / "corpus"
+        cdir.mkdir()
+        for i, t in enumerate(TEXTS):
+            (cdir / f"doc{i}.txt").write_text(t)
+        out = subprocess.run(
+            [sys.executable, "-m", "tfk8s_tpu.data.corpus",
+             "--input", str(cdir / "*.txt"),
+             "--out-dir", str(tmp_path / "shards"),
+             "--seq-len", "33", "--vocab-size", "400",
+             "--num-shards", "2",
+             "--tokenizer-dir", str(tmp_path / "tok")],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "TFK8S_JAX_PLATFORM": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr
+        from tfk8s_tpu.data import RecordFile, decode
+
+        shards = sorted((tmp_path / "shards").glob("part-*.rio"))
+        assert len(shards) == 2
+        rows = [
+            decode(r)["input"]
+            for p in shards
+            for r in RecordFile(str(p))
+        ]
+        assert all(r.shape == (33,) and r.dtype == np.int32 for r in rows)
+        # the written ids decode back through the SAVED tokenizer to the
+        # corpus vocabulary (text loop closes)
+        tok = BPETokenizer.load(str(tmp_path / "tok"))
+        text = tok.decode(rows[0])
+        assert "the" in text or "judge" in text or "box" in text, text
+
+    def test_rows_cover_stream_order(self, tmp_path):
+        tok = train_bpe(TEXTS, vocab_size=300, specials=[corpus_mod.PAD,
+                                                         corpus_mod.EOS])
+        rows = list(corpus_mod.pack_rows(tok, TEXTS, seq_len=16))
+        flat = np.concatenate(rows)
+        want = []
+        eos = tok.vocab[corpus_mod.EOS]
+        for t in TEXTS:
+            want.extend(tok.encode(t))
+            want.append(eos)
+        np.testing.assert_array_equal(flat, np.asarray(want[: len(flat)]))
+
+
+@pytest.mark.slow
+def test_text_to_training_to_text_e2e(tmp_path):
+    """The full loop: corpus → BPE tokenizer → record shards → GPT
+    fine-tune through the files input mode → text decode with the same
+    tokenizer. A model trained on the packed shards must prefer corpus
+    continuations over a random-init model (loss drops), and the decoded
+    continuation must be text from the tokenizer's vocabulary."""
+    import jax
+    import jax.numpy as jnp
+
+    from tfk8s_tpu.data import corpus
+    from tfk8s_tpu.models import gpt
+    from tfk8s_tpu.parallel.mesh import make_mesh
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+
+    tok = corpus.get_tokenizer(TEXTS, str(tmp_path / "tok"), vocab_size=320)
+    rows = corpus.pack_rows(tok, TEXTS, seq_len=17)
+    corpus.write_shards(rows, str(tmp_path / "shards"), num_shards=2)
+
+    cfg = gpt.tiny_config(vocab_size=tok.vocab_size, max_len=64)
+    mesh = make_mesh(data=8)
+    task = gpt.make_task(cfg=cfg, seq_len=17, batch_size=8)
+    trainer = Trainer(
+        task,
+        TrainConfig(
+            steps=60, learning_rate=3e-3, log_every=20,
+            input_mode="files",
+            input_files=str(tmp_path / "shards" / "part-*.rio"),
+        ),
+        mesh,
+    )
+    state, history = trainer.fit()
+    assert history[-1]["loss"] < history[0]["loss"], history
+
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    params = unbox(state.params)
+    prompt = jnp.asarray([tok.encode("the quick brown")], jnp.int32)
+    out = gpt.generate(cfg, params, prompt, num_tokens=8)
+    text = tok.decode(np.asarray(out)[0])
+    assert isinstance(text, str) and len(text) > 0
+
+
+def test_gpt_train_env_carries_vocab_size(monkeypatch):
+    """The TPUJob env contract can size the model to a custom tokenizer
+    (TFK8S_VOCAB_SIZE) — functional check: the task train() builds must
+    carry an embedding table of exactly the requested vocabulary."""
+    import jax
+
+    from tfk8s_tpu.models import gpt
+
+    captured = {}
+
+    def fake_run_task(task, env, stop, mesh=None):
+        captured["task"] = task
+
+    monkeypatch.setattr(gpt, "run_task", fake_run_task)
+    gpt.train({
+        "TFK8S_MODEL_PRESET": "tiny",
+        "TFK8S_VOCAB_SIZE": "96",
+        "TFK8S_SEQ_LEN": "16",
+        "TFK8S_BATCH_SIZE": "4",
+    })
+    from tfk8s_tpu.parallel.sharding import unbox
+
+    params = unbox(captured["task"].init(jax.random.key(0)))
+    emb = params["embed"]["tok"]["embedding"]
+    assert emb.shape[0] == 96, emb.shape

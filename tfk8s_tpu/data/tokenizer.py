@@ -1,0 +1,215 @@
+"""Self-contained byte-level BPE tokenizer (GPT-2 style).
+
+Closes the text loop around the serving stack (VERDICT r4 next #10): the
+HF interop imports GPT-2 *weights* (models/gpt.py), but turning text into
+the record shards the input pipeline feeds (data/recordio.py) — and
+decoded ids back into text — needed a tokenizer. This one is hermetic:
+
+- **byte-level**: text is mapped through the GPT-2 byte→unicode table
+  (a format constant: the 256 byte values relabelled onto printable
+  code points so merges files stay visually editable), so ANY input
+  round-trips losslessly — no unknown-token loss;
+- **trainable**: :func:`train_bpe` learns a merge list from a corpus
+  (classic pair-frequency BPE over pre-tokenized words), so the loop
+  works with zero downloads;
+- **HF-format vocab**: ``save``/``load`` write ``vocab.json`` +
+  ``merges.txt`` in the layout Hugging Face tokenizers use, so a real
+  GPT-2 vocabulary dropped into the same directory loads unchanged
+  (pairing with ``gpt.load_hf_gpt2`` weights).
+
+Pre-tokenization approximates GPT-2's regex with stdlib ``re`` (the
+original uses ``\\p{L}``/``\\p{N}`` classes from the third-party
+``regex`` module): contractions, letter runs, digit runs, punctuation
+runs, and space-prefixed words. For tokenizers TRAINED here the choice
+is self-consistent; byte-level fallback keeps encode total either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+# stdlib-re approximation of the GPT-2 split pattern
+_PRETOKEN = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d"
+    r"| ?[A-Za-zÀ-ɏ]+"
+    r"| ?[0-9]+"
+    r"| ?[^\sA-Za-z0-9À-ɏ]+"
+    r"|\s+(?!\S)|\s+"
+)
+
+
+def bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 byte→printable-unicode relabelling (format constant):
+    printable ASCII and two Latin-1 ranges map to themselves; the
+    remaining 68 byte values map to 256+n."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+_BYTE_ENC = bytes_to_unicode()
+_BYTE_DEC = {v: k for k, v in _BYTE_ENC.items()}
+
+
+def _to_byte_chars(text: str) -> str:
+    return "".join(_BYTE_ENC[b] for b in text.encode("utf-8"))
+
+
+def _apply_merge(symbols: Sequence[str], pair: Tuple[str, str]) -> List[str]:
+    """One left-to-right pass replacing adjacent ``pair`` occurrences with
+    their concatenation — the ONE merge-application used by both encoding
+    (_bpe) and training (train_bpe), so their semantics cannot drift."""
+    merged: List[str] = []
+    i = 0
+    while i < len(symbols):
+        if i < len(symbols) - 1 and (symbols[i], symbols[i + 1]) == pair:
+            merged.append(symbols[i] + symbols[i + 1])
+            i += 2
+        else:
+            merged.append(symbols[i])
+            i += 1
+    return merged
+
+
+class BPETokenizer:
+    """Encode/decode with a (vocab, merges) pair. ``vocab`` maps token
+    string (in byte-char space) → id; ``merges`` is the ordered merge
+    list, earlier = higher priority."""
+
+    def __init__(
+        self,
+        vocab: Dict[str, int],
+        merges: Sequence[Tuple[str, str]],
+        specials: Sequence[str] = (),
+    ):
+        self.vocab = dict(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.merges = [tuple(m) for m in merges]
+        self.specials = list(specials)
+        self._cache: Dict[str, List[str]] = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def _bpe(self, word: str) -> List[str]:
+        if word in self._cache:
+            return self._cache[word]
+        parts = list(word)
+        while len(parts) > 1:
+            pairs = {(parts[i], parts[i + 1]) for i in range(len(parts) - 1)}
+            best = min(
+                pairs, key=lambda p: self.ranks.get(p, float("inf"))
+            )
+            if best not in self.ranks:
+                break
+            parts = _apply_merge(parts, best)
+        if len(self._cache) < 65536:  # bound the per-process cache
+            self._cache[word] = parts
+        return parts
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for tok in _PRETOKEN.findall(text):
+            for piece in self._bpe(_to_byte_chars(tok)):
+                ids.append(self.vocab[piece])
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        chars = "".join(self.inv_vocab[int(i)] for i in ids)
+        data = bytes(_BYTE_DEC[c] for c in chars)
+        return data.decode("utf-8", errors="replace")
+
+    # -- HF-compatible persistence -----------------------------------------
+
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "vocab.json"), "w") as f:
+            json.dump(self.vocab, f, ensure_ascii=False)
+        with open(os.path.join(directory, "merges.txt"), "w") as f:
+            f.write("#version: 0.2\n")
+            for a, b in self.merges:
+                f.write(f"{a} {b}\n")
+
+    @classmethod
+    def load(cls, directory: str) -> "BPETokenizer":
+        with open(os.path.join(directory, "vocab.json")) as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(os.path.join(directory, "merges.txt")) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+
+def train_bpe(
+    texts: Iterable[str],
+    vocab_size: int,
+    specials: Sequence[str] = (),
+) -> BPETokenizer:
+    """Classic BPE training: count pre-tokenized words, then greedily
+    merge the most frequent adjacent symbol pair until ``vocab_size`` is
+    reached (256 byte-level symbols + specials + merges). Deterministic:
+    frequency ties break lexicographically."""
+    base = [_BYTE_ENC[b] for b in range(256)]
+    n_reserved = len(base) + len(specials)
+    if vocab_size < n_reserved:
+        raise ValueError(
+            f"vocab_size {vocab_size} < {n_reserved} "
+            "(256 byte symbols + specials)"
+        )
+    words: Dict[Tuple[str, ...], int] = {}
+    for text in texts:
+        for tok in _PRETOKEN.findall(text):
+            key = tuple(_to_byte_chars(tok))
+            words[key] = words.get(key, 0) + 1
+
+    merges: List[Tuple[str, str]] = []
+    vocab_tokens = set(base)
+    while len(vocab_tokens) + len(specials) < vocab_size:
+        pair_counts: Dict[Tuple[str, str], int] = {}
+        for word, cnt in words.items():
+            for i in range(len(word) - 1):
+                p = (word[i], word[i + 1])
+                pair_counts[p] = pair_counts.get(p, 0) + cnt
+        if not pair_counts:
+            break
+        best = max(pair_counts, key=lambda p: (pair_counts[p], p))
+        merges.append(best)
+        vocab_tokens.add(best[0] + best[1])
+        new_words: Dict[Tuple[str, ...], int] = {}
+        for word, cnt in words.items():
+            key = tuple(_apply_merge(word, best))
+            new_words[key] = new_words.get(key, 0) + cnt
+        words = new_words
+
+    # id order: specials first (stable ids for PAD/EOS regardless of
+    # corpus), then base bytes, then merges in creation order
+    vocab: Dict[str, int] = {}
+    for s in specials:
+        vocab[s] = len(vocab)
+    for t in base:
+        vocab[t] = len(vocab)
+    for a, b in merges:
+        tok = a + b
+        if tok not in vocab:
+            vocab[tok] = len(vocab)
+    return BPETokenizer(vocab, merges, specials=specials)

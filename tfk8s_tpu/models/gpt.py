@@ -724,11 +724,17 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     seq = int(env.get("TFK8S_SEQ_LEN", "128"))
     batch = int(env.get("TFK8S_BATCH_SIZE", "64"))
     preset = tiny_config if env.get("TFK8S_MODEL_PRESET") == "tiny" else base_config
-    cfg = preset(
+    cfg_kw = dict(
         num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
         moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
         attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"),
     )
+    if env.get("TFK8S_VOCAB_SIZE"):
+        # size the model to a custom tokenizer (data/tokenizer.py) — text
+        # fine-tuning through a job spec needs the vocab on the env
+        # contract, same as seq/batch
+        cfg_kw["vocab_size"] = int(env["TFK8S_VOCAB_SIZE"])
+    cfg = preset(**cfg_kw)
     ctx = ProcessContext.from_env(env)
     initialize_distributed(ctx, env)
     mesh = build_mesh(ctx)
