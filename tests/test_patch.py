@@ -394,3 +394,32 @@ class TestControllerUsesPatches:
         finally:
             stop.set()
             ctrl.controller.shutdown()
+
+
+class TestStatusPatchEdgeCases:
+    def test_null_status_resets_to_default_not_none(self):
+        """{"status": null} must reset to a fresh default status (key
+        deletion semantics) — a None status would crash every reader."""
+        s = ClusterStore()
+        s.create(make_job("j"))
+        got = s.get("TPUJob", "default", "j")
+        helpers.set_condition(got.status, JobConditionType.RUNNING, reason="r")
+        s.update_status(got)
+        out = s.patch(
+            "TPUJob", "default", "j", {"status": None}, subresource="status"
+        )
+        assert out.status is not None
+        assert out.status.conditions == []
+        assert s.get("TPUJob", "default", "j").status is not None
+
+    def test_statusless_kind_raises_store_error(self):
+        from tfk8s_tpu.api.types import ObjectMeta, Service
+        from tfk8s_tpu.client.store import StoreError
+
+        s = ClusterStore()
+        s.create(Service(metadata=ObjectMeta(name="svc", namespace="default")))
+        with pytest.raises(StoreError, match="status subresource"):
+            s.patch(
+                "Service", "default", "svc", {"status": {"x": 1}},
+                subresource="status",
+            )

@@ -179,3 +179,14 @@ def test_gpt_train_env_carries_vocab_size(monkeypatch):
     params = unbox(captured["task"].init(jax.random.key(0)))
     emb = params["embed"]["tok"]["embedding"]
     assert emb.shape[0] == 96, emb.shape
+
+
+def test_write_shards_leaves_nothing_on_failure(tmp_path):
+    """An invalid packing (fewer rows than shards) must not leave partial
+    part-*.rio files behind for a later run's glob to pick up."""
+    tok = train_bpe(TEXTS, vocab_size=300)
+    few_rows = iter([np.zeros((8,), np.int32)])  # 1 row for 4 shards
+    with pytest.raises(ValueError, match="fewer shards"):
+        corpus_mod.write_shards(few_rows, str(tmp_path / "out"), num_shards=4)
+    leftovers = list((tmp_path / "out").glob("part-*"))
+    assert leftovers == [], leftovers

@@ -72,24 +72,39 @@ def pack_rows(
 def write_shards(
     rows: Iterator[np.ndarray], out_dir: str, num_shards: int
 ) -> List[str]:
+    """Writes to temp names, renaming into ``part-*.rio`` only on
+    success — a failed/invalid packing must not leave partial shards
+    behind that a later run's ``part-*.rio`` glob would feed a host."""
     os.makedirs(out_dir, exist_ok=True)
     paths = [
         os.path.join(out_dir, f"part-{i:04d}.rio") for i in range(num_shards)
     ]
-    writers = [RecordWriter(p) for p in paths]
+    tmps = [p + ".tmp" for p in paths]
+    writers = [RecordWriter(p) for p in tmps]
     n = 0
+    ok = False
     try:
         for row in rows:
             writers[n % num_shards].write(encode({"input": row}))
             n += 1
+        if n < num_shards:
+            raise ValueError(
+                f"corpus packed into only {n} rows for {num_shards} shards — "
+                "use fewer shards, a shorter seq_len, or more text"
+            )
+        ok = True
     finally:
         for w in writers:
             w.close()
-    if n < num_shards:
-        raise ValueError(
-            f"corpus packed into only {n} rows for {num_shards} shards — "
-            "use fewer shards, a shorter seq_len, or more text"
-        )
+        if ok:
+            for t, p in zip(tmps, paths):
+                os.replace(t, p)
+        else:
+            for t in tmps:
+                try:
+                    os.unlink(t)
+                except OSError:
+                    pass
     return paths
 
 
