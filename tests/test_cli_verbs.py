@@ -174,3 +174,55 @@ def test_user_errors_exit_1_not_traceback(cluster, tmp_path):
     bare = tmp_path / "bare.yaml"
     bare.write_text("just a string")
     assert main(["submit", "--kubeconfig", kc, "--file", str(bare)]) == 1
+
+
+def test_patch_verb_merge_patches_over_the_wire(cluster, tmp_path, capsys):
+    """`kubectl patch` parity: the CLI patch verb sends an RFC 7386 merge
+    patch; the server admits the merged result (422 surfaced on invalid)
+    and malformed JSON is a user error, not a traceback."""
+    server, kc = cluster
+    manifest = write_manifest(tmp_path)
+    assert main(["submit", "--kubeconfig", kc, "--file", manifest]) == 0
+    capsys.readouterr()
+
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job",
+        "-p", '{"spec": {"runPolicy": {"suspend": true}}}',
+    ]) == 0
+    assert "patched" in capsys.readouterr().out
+    job = server.store.get("TPUJob", "default", "cli-job")
+    assert job.spec.run_policy.suspend is True
+
+    # invalid merged result -> admission 422 surfaced, object unchanged
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job",
+        "-p", '{"spec": {"tpu": {"accelerator": "v5p-33"}}}',
+    ]) == 1
+    job = server.store.get("TPUJob", "default", "cli-job")
+    assert job.spec.tpu.accelerator == "cpu-1"
+
+    # malformed JSON -> clean error
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "-p", "{not json",
+    ]) == 1
+
+    # silent-no-op guards: a bare status body without --subresource, and
+    # a --subresource status body without the wrapper, both error instead
+    # of reporting a successful non-change
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job",
+        "-p", '{"status": {"replicaStatuses": {}}}',
+    ]) == 1
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
+        "-p", '{"replicaStatuses": {"Worker": {"active": 1}}}',
+    ]) == 1
+
+    # status subresource routing
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
+        "-p", '{"status": {"replicaStatuses": {"Worker": {"active": 1}}}}',
+    ]) == 0
+    job = server.store.get("TPUJob", "default", "cli-job")
+    from tfk8s_tpu.api.types import ReplicaType
+    assert job.status.replica_statuses[ReplicaType.WORKER].active == 1

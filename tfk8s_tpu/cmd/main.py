@@ -113,6 +113,18 @@ def _build_parser() -> argparse.ArgumentParser:
     p_get.add_argument("--watch-timeout", type=float, default=0.0,
                        help="stop watching after N seconds (0 = forever)")
 
+    p_patch = kubectlish("patch", "merge-patch fields of one object "
+                                  "(kubectl patch parity; RFC 7386)")
+    p_patch.add_argument("name")
+    p_patch.add_argument("-p", "--patch", required=True,
+                         help='merge patch as JSON, e.g. '
+                              '\'{"spec": {"runPolicy": {"suspend": true}}}\'')
+    p_patch.add_argument("--kind", default="tpujobs",
+                         choices=("tpujobs", "pods", "services"))
+    p_patch.add_argument("--subresource", default="",
+                         choices=("", "status"),
+                         help="patch the status subresource instead")
+
     p_desc = kubectlish("describe", "full detail of one TPUJob")
     p_desc.add_argument("name")
 
@@ -673,6 +685,53 @@ def _cmd_delete(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_patch(args: argparse.Namespace) -> int:
+    """`kubectl patch` parity: an RFC 7386 merge patch straight to the
+    wire verb — touch only the fields named; no resourceVersion, no
+    read-modify-write. The server runs admission on the merged object
+    (422 on an invalid result)."""
+    from tfk8s_tpu.client.apiserver import PLURALS
+    from tfk8s_tpu.client.remote import clientset_from_kubeconfig
+
+    cs = clientset_from_kubeconfig(args.kubeconfig)
+    try:
+        patch = json.loads(args.patch)
+    except ValueError as e:
+        log.error("patch: --patch is not valid JSON: %s", e)
+        return 1
+    if not isinstance(patch, dict):
+        log.error("patch: --patch must be a JSON object, got %s",
+                  type(patch).__name__)
+        return 1
+    # catch silent no-ops before reporting success: a status patch needs
+    # the {"status": ...} wrapper, and a main-resource patch that is ONLY
+    # a status key would have that key dropped by subresource isolation
+    if args.subresource == "status" and "status" not in patch:
+        log.error(
+            "patch: --subresource status expects the wrapper form "
+            '\'{"status": {...}}\'; this patch would apply nothing'
+        )
+        return 1
+    if not args.subresource and set(patch) == {"status"}:
+        log.error(
+            "patch: status is a subresource — this patch would be dropped "
+            "by subresource isolation; add --subresource status"
+        )
+        return 1
+    kind = PLURALS[args.kind]
+    client = cs.generic(kind, args.namespace)
+    if args.subresource == "status":
+        out = client.patch_status(args.name, patch)
+    else:
+        out = client.patch(args.name, patch)
+    sub = "/status" if args.subresource else ""
+    print(
+        f"{args.kind[:-1]} {args.namespace}/{args.name}{sub} patched "
+        f"(rv {out.metadata.resource_version})"
+    )
+    return 0
+
+
 def _cmd_logs(args: argparse.Namespace) -> int:
     """`kubectl logs` parity: the tail rides pod status (captured by the
     kubelet, PodStatus.log_tail), so reading it is a plain GET — no
@@ -759,11 +818,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _cmd_kubelet(args)
     if args.command in (
         "submit", "get", "describe", "delete", "logs", "scale", "apply",
-        "suspend", "resume",
+        "suspend", "resume", "patch",
     ):
         init_logging()
         handler = {
             "submit": _cmd_submit,
+            "patch": _cmd_patch,
             "get": _cmd_get,
             "describe": _cmd_describe,
             "delete": _cmd_delete,
