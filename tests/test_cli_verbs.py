@@ -206,16 +206,25 @@ def test_patch_verb_merge_patches_over_the_wire(cluster, tmp_path, capsys):
         "patch", "--kubeconfig", kc, "cli-job", "-p", "{not json",
     ]) == 1
 
-    # silent-no-op guards: a bare status body without --subresource, and
-    # a --subresource status body without the wrapper, both error instead
-    # of reporting a successful non-change
+    # silent-drop guards: ANY status key on a main-resource patch, a
+    # --subresource status body without the wrapper, and a mixed
+    # subresource body carrying spec keys — all error instead of
+    # reporting success while fields vanish
     assert main([
         "patch", "--kubeconfig", kc, "cli-job",
         "-p", '{"status": {"replicaStatuses": {}}}',
     ]) == 1
     assert main([
+        "patch", "--kubeconfig", kc, "cli-job",
+        "-p", '{"spec": {"runPolicy": {"suspend": false}}, "status": {}}',
+    ]) == 1
+    assert main([
         "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
         "-p", '{"replicaStatuses": {"Worker": {"active": 1}}}',
+    ]) == 1
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
+        "-p", '{"status": {}, "spec": {"runPolicy": {"suspend": false}}}',
     ]) == 1
 
     # status subresource routing

@@ -342,3 +342,23 @@ def test_fully_padded_row_gradients_finite_and_match(causal):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(w), atol=2e-4, err_msg=f"d{name}"
         )
+
+
+def test_cross_attention_unequal_lengths_with_mask():
+    """Enc-dec cross-attention shape: queries from a 16-token decoder,
+    keys/values from a 32-token padded encoder, both sequence-sharded 4
+    ways. Ring attention must handle lq != lk with the key mask rotating
+    on the KEY length."""
+    mesh = make_mesh(sequence=4)
+    b, lq, lk, h, d = 2, 16, 32, 4, 8
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((b, lq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, lk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, lk, h, d)), jnp.float32)
+    mask = _padded_mask(b, lk, [27, 18])
+    ring = make_ring_attn_fn(mesh)
+    got = ring(q, k, v, mask=mask, causal=False)
+    want = dot_product_attention(q, k, v, mask=mask, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )

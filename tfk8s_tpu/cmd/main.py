@@ -703,19 +703,30 @@ def _cmd_patch(args: argparse.Namespace) -> int:
         log.error("patch: --patch must be a JSON object, got %s",
                   type(patch).__name__)
         return 1
-    # catch silent no-ops before reporting success: a status patch needs
-    # the {"status": ...} wrapper, and a main-resource patch that is ONLY
-    # a status key would have that key dropped by subresource isolation
-    if args.subresource == "status" and "status" not in patch:
+    # catch silently-dropped fields before reporting success: subresource
+    # isolation applies EXACTLY ONE side of the object per call, so any
+    # key on the wrong side of the split would vanish while the CLI
+    # printed "patched"
+    if args.subresource == "status":
+        if "status" not in patch:
+            log.error(
+                "patch: --subresource status expects the wrapper form "
+                '\'{"status": {...}}\'; this patch would apply nothing'
+            )
+            return 1
+        extras = sorted(set(patch) - {"status"})
+        if extras:
+            log.error(
+                "patch: --subresource status applies ONLY the status "
+                "subtree; %s would be silently dropped — patch them in a "
+                "separate call without --subresource", extras,
+            )
+            return 1
+    elif "status" in patch:
         log.error(
-            "patch: --subresource status expects the wrapper form "
-            '\'{"status": {...}}\'; this patch would apply nothing'
-        )
-        return 1
-    if not args.subresource and set(patch) == {"status"}:
-        log.error(
-            "patch: status is a subresource — this patch would be dropped "
-            "by subresource isolation; add --subresource status"
+            "patch: status is a subresource and would be dropped by "
+            "subresource isolation — patch it in a separate call with "
+            "--subresource status"
         )
         return 1
     kind = PLURALS[args.kind]
