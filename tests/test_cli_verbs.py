@@ -226,6 +226,26 @@ def test_patch_verb_merge_patches_over_the_wire(cluster, tmp_path, capsys):
         "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
         "-p", '{"status": {}, "spec": {"runPolicy": {"suspend": false}}}',
     ]) == 1
+    # ...but server-honored keys pass the guard: the rv precondition and
+    # the wire envelope ride along with a status patch (full-wire form)
+    job = server.store.get("TPUJob", "default", "cli-job")
+    rv = job.metadata.resource_version
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
+        "-p", json.dumps({
+            "apiVersion": "tpu.tfk8s.dev/v1alpha1", "kind": "TPUJob",
+            "metadata": {"resourceVersion": str(rv)},
+            "status": {"replicaStatuses": {"Worker": {"active": 2}}},
+        }),
+    ]) == 0
+    # a STALE rv precondition is enforced server-side (409 -> exit 1)
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
+        "-p", json.dumps({
+            "metadata": {"resourceVersion": str(rv)},  # now stale
+            "status": {"replicaStatuses": {"Worker": {"active": 3}}},
+        }),
+    ]) == 1
 
     # status subresource routing
     assert main([

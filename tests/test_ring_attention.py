@@ -362,3 +362,43 @@ def test_cross_attention_unequal_lengths_with_mask():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-5
     )
+
+
+@pytest.mark.parametrize("shape", [(16, 32), (32, 16)])
+def test_causal_unequal_lengths_end_aligned(shape):
+    """Causal masking with lq != lk follows the END-aligned convention of
+    the reference (tril k=lk-lq) and the flash kernels — query i attends
+    keys j <= i + (Lk - Lq) — including gradients."""
+    lq, lk = shape
+    mesh = make_mesh(sequence=4)
+    b, h, d = 2, 4, 8
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((b, lq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, lk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, lk, h, d)), jnp.float32)
+    ring = make_ring_attn_fn(mesh)
+    got = ring(q, k, v, causal=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    # gradient parity holds on rows with >= 1 visible key; rows with NONE
+    # (possible when lq > lk: i + lk - lq < 0) produce garbage-in-garbage-
+    # out values both ways, and their grads are defined only up to loss
+    # masking — so the loss (realistically) masks them, same contract as
+    # the padded-grad tests
+    valid_q = (np.arange(lq) + lk - lq >= 0).astype(np.float32)[None, :, None, None]
+
+    def loss_ring(q, k, v):
+        out = ring(q, k, v, causal=True).astype(jnp.float32)
+        return jnp.sum((out * valid_q) ** 2)
+
+    def loss_full(q, k, v):
+        out = dot_product_attention(q, k, v, causal=True).astype(jnp.float32)
+        return jnp.sum((out * valid_q) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, w, name in zip(gr, gw, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(w), atol=2e-4, err_msg=f"d{name}"
+        )

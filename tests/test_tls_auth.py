@@ -153,6 +153,17 @@ class TestSecuredWire:
             viewer.create(make_job("ro-write"))
         with pytest.raises(Forbidden):
             viewer.delete("TPUJob", "default", "ro-visible")
+        # PATCH is a write too — the r5 verb must sit behind the same gate
+        with pytest.raises(Forbidden):
+            viewer.patch(
+                "TPUJob", "default", "ro-visible",
+                {"spec": {"runPolicy": {"suspend": True}}},
+            )
+        with pytest.raises(Forbidden):
+            viewer.patch(
+                "TPUJob", "default", "ro-visible",
+                {"status": {}}, subresource="status",
+            )
 
     def test_unauthorized_post_closes_keepalive_cleanly(self, secured, pki):
         # the gate fires before the body is read; the server must signal

@@ -714,7 +714,13 @@ def _cmd_patch(args: argparse.Namespace) -> int:
                 '\'{"status": {...}}\'; this patch would apply nothing'
             )
             return 1
-        extras = sorted(set(patch) - {"status"})
+        # envelope keys and metadata are server-honored on status
+        # patches (metadata.resourceVersion acts as an optimistic
+        # precondition; apiVersion/kind are the wire envelope) — only
+        # genuinely-dropped keys (spec, ...) are rejected
+        extras = sorted(
+            set(patch) - {"status", "metadata", "apiVersion", "kind"}
+        )
         if extras:
             log.error(
                 "patch: --subresource status applies ONLY the status "

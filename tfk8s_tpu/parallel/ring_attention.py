@@ -117,7 +117,12 @@ def _ring_fwd_impl(q, k, v, mask, axis_name, causal, block_k):
     bk = _pick_bk(lk, block_k)
 
     qf = q.astype(jnp.float32)
-    q_pos = me * lq + jnp.arange(lq)  # global query positions
+    # global query positions, END-ALIGNED for unequal lengths: the
+    # reference convention (dot_product_attention's tril k=lk-lq, the
+    # flash kernels' bottom-right alignment) lets query i attend keys
+    # j <= i + (Lk - Lq); shifting q_pos by the global length difference
+    # reproduces it exactly (zero shift in the lq == lk self-attn case)
+    q_pos = me * lq + jnp.arange(lq) + ring * (lk - lq)
 
     m0 = jnp.full((b, h, lq), _NEG, jnp.float32)
     l0 = jnp.zeros((b, h, lq), jnp.float32)
@@ -167,7 +172,8 @@ def _ring_bwd_impl(q, k, v, mask, out, lse, g, axis_name, causal, block_k):
 
     qf = q.astype(jnp.float32)
     do = g.astype(jnp.float32)
-    q_pos = me * lq + jnp.arange(lq)
+    # end-aligned, matching the forward (see _ring_fwd_impl)
+    q_pos = me * lq + jnp.arange(lq) + ring * (lk - lq)
     # D = rowsum(dO ∘ O) — the FlashAttention-2 softmax-grad shortcut
     dvec = jnp.sum(do * out.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
@@ -315,7 +321,16 @@ def make_ring_attn_fn(
     ring with its k/v block, so padded/packed batches keep exact SP —
     they no longer have to fall back to full attention. (Full [q, k]
     masks are not supported: their rows are query-sharded AND their
-    columns key-sharded, which the ring layout cannot carry.)"""
+    columns key-sharded, which the ring layout cannot carry.)
+
+    Unequal lengths (cross-attention: decoder queries over encoder
+    keys) are supported; ``causal`` then follows the END-aligned
+    convention of ``dot_product_attention`` (tril ``k=lk-lq``) and the
+    flash kernels — query i attends keys ``j <= i + (Lk - Lq)``.
+    Queries with zero visible keys (possible when Lq > Lk) return the
+    same uniform-weights value as the reference; their gradients are
+    defined only up to loss masking — mask them out of the loss, as any
+    real objective does."""
     if seq_axis not in mesh.axis_names:
         # fail at construction with the fix, not at trace time with a
         # shard_map unknown-axis error (same contract as ulysses.py)
