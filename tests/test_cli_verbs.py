@@ -238,6 +238,13 @@ def test_patch_verb_merge_patches_over_the_wire(cluster, tmp_path, capsys):
             "status": {"replicaStatuses": {"Worker": {"active": 2}}},
         }),
     ]) == 0
+    # metadata keys OTHER than the rv precondition are dropped by the
+    # status fast path -> guard rejects them
+    assert main([
+        "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",
+        "-p", '{"status": {}, "metadata": {"labels": {"team": "x"}}}',
+    ]) == 1
+
     # a STALE rv precondition is enforced server-side (409 -> exit 1)
     assert main([
         "patch", "--kubeconfig", kc, "cli-job", "--subresource", "status",

@@ -60,6 +60,7 @@ def test_control_plane_bench_small():
     for k in (
         "memory_creates_per_s", "memory_status_patches_per_s",
         "journal_creates_per_s", "journal_status_patches_per_s",
+        "journal_fsync_creates_per_s",
     ):
         assert out[k] > 0, (k, out)
     assert out["watch_fanout"]["complete"], out["watch_fanout"]

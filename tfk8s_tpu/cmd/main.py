@@ -714,18 +714,23 @@ def _cmd_patch(args: argparse.Namespace) -> int:
                 '\'{"status": {...}}\'; this patch would apply nothing'
             )
             return 1
-        # envelope keys and metadata are server-honored on status
-        # patches (metadata.resourceVersion acts as an optimistic
-        # precondition; apiVersion/kind are the wire envelope) — only
-        # genuinely-dropped keys (spec, ...) are rejected
+        # envelope keys are server-honored on status patches
+        # (apiVersion/kind are the wire envelope; within metadata ONLY
+        # resourceVersion — the optimistic precondition — is read) —
+        # every genuinely-dropped key is rejected
         extras = sorted(
             set(patch) - {"status", "metadata", "apiVersion", "kind"}
         )
-        if extras:
+        meta_extras = sorted(
+            set(patch.get("metadata") or {}) - {"resourceVersion"}
+        )
+        if extras or meta_extras:
+            dropped = extras + [f"metadata.{k}" for k in meta_extras]
             log.error(
                 "patch: --subresource status applies ONLY the status "
-                "subtree; %s would be silently dropped — patch them in a "
-                "separate call without --subresource", extras,
+                "subtree (+ the metadata.resourceVersion precondition); "
+                "%s would be silently dropped — patch them in a separate "
+                "call without --subresource", dropped,
             )
             return 1
     elif "status" in patch:

@@ -81,6 +81,18 @@ def bench_store(n_writes: int) -> Dict[str, float]:
     one(ClusterStore(), "memory")
     with tempfile.TemporaryDirectory(prefix="cpbench-journal-") as d:
         one(ClusterStore(journal_dir=d, fsync=False), "journal")
+    # the durability tax, quantified: fsync-per-write is the power-loss-
+    # safe default (--no-fsync opts out); a smaller write count keeps the
+    # row cheap on slow disks
+    with tempfile.TemporaryDirectory(prefix="cpbench-fsync-") as d:
+        store = ClusterStore(journal_dir=d, fsync=True)
+        n_f = max(n_writes // 10, 20)
+        t0 = time.perf_counter()
+        for i in range(n_f):
+            store.create(_make_job(f"fsync-{i:05d}"))
+        out["journal_fsync_creates_per_s"] = round(
+            n_f / (time.perf_counter() - t0), 1
+        )
     return out
 
 
