@@ -721,9 +721,14 @@ def _cmd_patch(args: argparse.Namespace) -> int:
         extras = sorted(
             set(patch) - {"status", "metadata", "apiVersion", "kind"}
         )
-        meta_extras = sorted(
-            set(patch.get("metadata") or {}) - {"resourceVersion"}
-        )
+        meta = patch.get("metadata") or {}
+        if not isinstance(meta, dict):
+            log.error(
+                "patch: metadata must be a JSON object, got %s",
+                type(meta).__name__,
+            )
+            return 1
+        meta_extras = sorted(set(meta) - {"resourceVersion"})
         if extras or meta_extras:
             dropped = extras + [f"metadata.{k}" for k in meta_extras]
             log.error(

@@ -1,0 +1,189 @@
+"""ViT image classifier — the transformer-on-images family, sharing ONE
+encoder stack with BERT/GPT/T5 (models/transformer.EncoderLayer), so
+every parallelism strategy and attention impl the text families get
+(TP via the logical sharding rules, SP via the shared select_attn_fn
+policy, flash kernels, MoE layers) applies to vision unchanged.
+
+Beyond the five reference baseline configs (SURVEY.md §6): the reference
+operator is model-agnostic, and a framework claiming its capabilities
+should demonstrate the SAME agnosticism — a new family is a patch
+embedding plus a head around the existing stack, not a new stack.
+
+Hermetic data: the class-conditional template images ResNet trains on
+(models/resnet.make_batch_fn), so the two vision families are directly
+comparable on one task.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from tfk8s_tpu.models.resnet import make_batch_fn
+from tfk8s_tpu.models.transformer import (
+    EncoderLayer,
+    TransformerConfig,
+    _dense,
+    _ln,
+    maybe_remat,
+)
+from tfk8s_tpu.runtime.train import TrainTask, run_task
+
+
+class ViT(nn.Module):
+    """Patchify → linear embed (+ learned positions) → shared encoder
+    stack → mean-pool → linear head. Mean-pool instead of a CLS token:
+    one less sequence position to shard and equal accuracy at this
+    scale."""
+
+    cfg: TransformerConfig
+    num_classes: int
+    patch_size: int
+    attn_fn: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        cfg, p = self.cfg, self.patch_size
+        b, h, w, c = images.shape
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch {p}")
+        gh, gw = h // p, w // p
+        x = images.reshape(b, gh, p, gw, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, gh * gw, p * p * c)
+        x = _dense(cfg.embed_dim, (None, "embed"), "patch_embed", cfg.dtype)(x)
+        pos = self.param(
+            "pos",
+            nn.with_partitioning(nn.initializers.normal(0.02), (None, "embed")),
+            (gh * gw, cfg.embed_dim),
+            jnp.float32,
+        )
+        x = (x + pos[None]).astype(cfg.dtype)
+        layer = maybe_remat(EncoderLayer, cfg)
+        for i in range(cfg.num_layers):
+            x = layer(
+                cfg,
+                attn_fn=self.attn_fn,
+                use_moe=cfg.layer_uses_moe(i),
+                name=f"layer{i}",
+            )(x, None)
+        x = _ln("ln_final", cfg.ln_eps)(x).astype(cfg.dtype)
+        x = jnp.mean(x, axis=1)
+        logits = _dense(
+            self.num_classes, ("embed", None), "head", jnp.float32
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def base_config(**overrides) -> TransformerConfig:
+    """ViT-Base scale: 12 layers / 768 / 12 heads / 3072 (vocab unused —
+    images enter through the patch projection)."""
+    kw = dict(
+        vocab_size=1, embed_dim=768, num_heads=12, head_dim=64,
+        mlp_dim=3072, num_layers=12, max_len=1024,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    kw = dict(
+        vocab_size=1, embed_dim=32, num_heads=4, head_dim=8,
+        mlp_dim=64, num_layers=2, max_len=256,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def make_task(
+    cfg: Optional[TransformerConfig] = None,
+    num_classes: int = 8,
+    image_size: int = 32,
+    patch_size: int = 4,
+    batch_size: int = 64,
+    targets: Optional[Dict[str, float]] = None,
+    attn_fn: Optional[Any] = None,
+) -> TrainTask:
+    cfg = cfg or tiny_config()
+    model = ViT(
+        cfg, num_classes=num_classes, patch_size=patch_size, attn_fn=attn_fn
+    )
+
+    def init(rng):
+        # full batch shape: an SP attn_fn's shard_map needs the real batch
+        # dim even at trace time (same as bert/t5)
+        z = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+        return model.init(rng, z)["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = model.apply({"params": params}, batch["image"])
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]
+            )
+        )
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+        )
+        return loss, {"accuracy": acc}
+
+    return TrainTask(
+        name="vit",
+        init=init,
+        loss_fn=loss_fn,
+        make_batch=make_batch_fn(num_classes, image_size),
+        batch_size=batch_size,
+        targets=targets or {},
+    )
+
+
+def task_for_mesh(mesh, cfg: Optional[TransformerConfig] = None, **task_kw):
+    """Shared attention policy (transformer.select_attn_fn): the patch
+    sequence shards over `sequence` like any token sequence — Ulysses
+    within the head count, ring beyond, flash on long patch grids."""
+    from tfk8s_tpu.models.transformer import select_attn_fn
+
+    cfg = cfg or tiny_config()
+    img = task_kw.get("image_size", 32)
+    patch = task_kw.get("patch_size", 4)
+    seq_len = (img // patch) ** 2
+    return make_task(
+        cfg=cfg, attn_fn=select_attn_fn(mesh, cfg, seq_len), **task_kw
+    )
+
+
+def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
+    """TPUJob entrypoint: ``tfk8s_tpu.models.vit:train``. Builds the mesh
+    and routes through ``task_for_mesh`` like the text families, so
+    ``TFK8S_ATTENTION_IMPL`` pins are honored (or rejected loudly) by the
+    shared policy instead of being silently ignored."""
+    from tfk8s_tpu.runtime.launcher import (
+        ProcessContext,
+        build_mesh,
+        initialize_distributed,
+    )
+
+    env = dict(env)
+    env.setdefault("TFK8S_TRAIN_STEPS", "150")
+    env.setdefault("TFK8S_LEARNING_RATE", "1e-3")
+    preset = tiny_config if env.get("TFK8S_MODEL_PRESET") == "tiny" else base_config
+    cfg = preset(attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"))
+    ctx = ProcessContext.from_env(env)
+    initialize_distributed(ctx, env)
+    mesh = build_mesh(ctx)
+    task = task_for_mesh(
+        mesh,
+        cfg=cfg,
+        num_classes=int(env.get("TFK8S_NUM_CLASSES", "8")),
+        image_size=int(env.get("TFK8S_IMAGE_SIZE", "32")),
+        patch_size=int(env.get("TFK8S_PATCH_SIZE", "4")),
+        batch_size=int(env.get("TFK8S_BATCH_SIZE", "64")),
+        targets={"accuracy": float(env["TFK8S_TARGET_ACCURACY"])}
+        if env.get("TFK8S_TARGET_ACCURACY")
+        else None,
+    )
+    run_task(task, env, stop, mesh=mesh)
