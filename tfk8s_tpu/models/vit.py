@@ -30,6 +30,7 @@ from tfk8s_tpu.models.transformer import (
     TransformerConfig,
     _dense,
     _ln,
+    apply_with_aux,
     maybe_remat,
 )
 from tfk8s_tpu.runtime.train import TrainTask, run_task
@@ -120,7 +121,10 @@ def make_task(
         return model.init(rng, z)["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        logits = model.apply({"params": params}, batch["image"])
+        # apply_with_aux collects the sown MoE load-balance loss — same
+        # plumbing as the text families, so MoE ViT layers actually get
+        # their balancing pressure instead of silently training dense
+        logits, aux = apply_with_aux(model, cfg, params, batch["image"])
         loss = jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(
                 logits, batch["label"]
@@ -129,7 +133,11 @@ def make_task(
         acc = jnp.mean(
             (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
         )
-        return loss, {"accuracy": acc}
+        metrics = {"accuracy": acc}
+        if cfg.num_experts > 0:
+            metrics["moe_aux"] = aux
+            loss = loss + cfg.moe_aux_weight * aux
+        return loss, metrics
 
     return TrainTask(
         name="vit",
@@ -171,7 +179,11 @@ def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
     env.setdefault("TFK8S_TRAIN_STEPS", "150")
     env.setdefault("TFK8S_LEARNING_RATE", "1e-3")
     preset = tiny_config if env.get("TFK8S_MODEL_PRESET") == "tiny" else base_config
-    cfg = preset(attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"))
+    cfg = preset(
+        attention_impl=env.get("TFK8S_ATTENTION_IMPL", "auto"),
+        num_experts=int(env.get("TFK8S_NUM_EXPERTS", "0")),
+        moe_top_k=int(env.get("TFK8S_MOE_TOP_K", "1")),
+    )
     ctx = ProcessContext.from_env(env)
     initialize_distributed(ctx, env)
     mesh = build_mesh(ctx)
