@@ -404,3 +404,83 @@ class TestWireWatchRecovery:
             server2.shutdown()
             server2.server_close()  # don't leak the bound listener
             store2.close()
+
+
+class TestAppendFailure:
+    """The write-AHEAD contract under IO failure: a failed append commits
+    nothing, rolls the WAL back to its last good byte, and — when even
+    rollback fails — poisons the store rather than risking divergence."""
+
+    def test_failed_append_commits_nothing_and_rolls_back(self, tmp_path):
+        d = str(tmp_path / "j")
+        s = ClusterStore(journal_dir=d, fsync=False)
+        s.create(make_job("good"))
+        wal = os.path.join(d, "wal.jsonl")
+        good_bytes = open(wal, "rb").read()
+
+        class FailingWal:
+            def __init__(self, inner):
+                self._inner = inner
+            def tell(self):
+                return self._inner.tell()
+            def write(self, data):
+                self._inner.write(data[: len(data) // 2])  # torn write...
+                raise OSError(28, "No space left on device")
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        s._wal = FailingWal(s._wal)
+        with pytest.raises(OSError):
+            s.create(make_job("doomed"))
+        # nothing observable: reads see no ghost object...
+        with pytest.raises(StoreError):
+            s.get("TPUJob", "default", "doomed")
+        # ...the WAL is byte-identical to its last good state...
+        assert open(wal, "rb").read() == good_bytes
+        # ...and the store recovered a working handle: next write lands
+        s.create(make_job("after-enospc"))
+        s.close()
+        r = ClusterStore(journal_dir=d, fsync=False)
+        assert sorted(o.metadata.name for o in r.list("TPUJob")[0]) == [
+            "after-enospc", "good",
+        ]
+        r.close()
+
+    def test_unrecoverable_append_poisons_the_store(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "j")
+        s = ClusterStore(journal_dir=d, fsync=False)
+        s.create(make_job("good"))
+
+        class DoomedWal:
+            def tell(self):
+                return 0
+            def write(self, data):
+                raise OSError(5, "I/O error")
+            def close(self):
+                raise OSError(5, "I/O error")
+
+        s._wal = DoomedWal()
+        # simulate the rollback ALSO failing: reopening wal.jsonl for
+        # append raises (the on-disk file itself stays intact) -> poison
+        real_open = open
+
+        def failing_open(path, *a, **kw):
+            if str(path).endswith("wal.jsonl") and "a" in (a[0] if a else kw.get("mode", "")):
+                raise OSError(5, "I/O error")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", failing_open)
+        with pytest.raises(OSError):
+            s.create(make_job("doomed"))
+        monkeypatch.undo()
+        # poisoned: EVERY further mutation refuses (availability traded
+        # for durability, per the docstring)
+        with pytest.raises(StoreError, match="poisoned"):
+            s.create(make_job("rejected"))
+        # ...and the durability half of the trade holds: the intact WAL
+        # re-replays every ACKED record on restart, exactly what the
+        # poison message promises ("restart the apiserver to re-replay")
+        s._wal = None  # DoomedWal.close raises; drop it instead
+        r = ClusterStore(journal_dir=d, fsync=False)
+        assert [o.metadata.name for o in r.list("TPUJob")[0]] == ["good"]
+        r.close()
