@@ -33,6 +33,10 @@ def test_dryrun_multichip_runs_on_virtual_mesh():
     ge.dryrun_multichip(8)  # raises on any failure
 
 
+import pytest
+
+
+@pytest.mark.slow  # full bench.py subprocess: multi-minute even at BENCH_SMALL
 def test_bench_small_emits_one_json_line():
     env = dict(os.environ)
     env.update({"BENCH_SMALL": "1", "BENCH_PLATFORM": "cpu"})

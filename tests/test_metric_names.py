@@ -1,0 +1,34 @@
+"""Metric-namespace lint, wired into tier-1 (ISSUE 1 satellite): every
+literal metric name the package registers must expose snake_case with
+unit suffixes (_total for counters, _seconds/_bytes for histograms) — the
+namespace stays coherent as instrumentation grows."""
+
+import os
+
+from tools.check_metric_names import lint_paths, lint_source
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_codebase_metric_names_are_coherent():
+    problems = lint_paths(
+        [os.path.join(_ROOT, "tfk8s_tpu"), os.path.join(_ROOT, "tools")]
+    )
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_catches_bad_names():
+    src = "\n".join(
+        [
+            'm.inc("tpujob.syncs")',            # counter missing _total
+            'm.observe("latency")',             # histogram missing unit
+            'm.set_gauge("Bad-Name.g")',        # uppercase survives sanitize
+            'm.inc(f"{self.name}.retries_total")',  # ok: f-string prefix
+            'm.observe("sync_seconds")',        # ok
+        ]
+    )
+    problems = lint_source("x.py", src)
+    assert len(problems) == 3, problems
+    assert any("_total" in p for p in problems)
+    assert any("_seconds" in p for p in problems)
+    assert any("snake_case" in p for p in problems)

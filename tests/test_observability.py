@@ -1,7 +1,9 @@
-"""Observability + manifest tests: metrics histograms and Prometheus
-exposition, the /metrics//healthz//events HTTP endpoint, reconcile
-latency recording, YAML manifest submission (SURVEY.md §5 — all marked
-ABSENT in the reference, added by the build; C20 CRD manifest)."""
+"""Observability + manifest tests: labeled metrics and Prometheus
+exposition (escaping, HELP lines, label GC), the /metrics//healthz/
+/events HTTP endpoint with query filters, reconcile latency recording,
+workqueue instrumentation under concurrency, YAML manifest submission
+(SURVEY.md §5 — all marked ABSENT in the reference, added by the build;
+C20 CRD manifest)."""
 
 import json
 import threading
@@ -13,6 +15,8 @@ from tfk8s_tpu.cmd.options import Options
 from tfk8s_tpu.cmd.server import Server
 from tfk8s_tpu.runtime import registry
 from tfk8s_tpu.utils.logging import Metrics
+
+from conftest import wait_for
 
 DONE = {}
 
@@ -202,12 +206,283 @@ def test_training_progress_reaches_operator_metrics():
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=5
         ).read().decode()
-        assert "tpujob_training_default_progjob_steps_per_sec 2" in body
-        assert "tpujob_training_default_progjob_examples_per_sec 64" in body
-        assert "tpujob_training_default_progjob_step" in body
+        # labeled per-job series: one metric name, the job identity rides
+        # the label set (labels render sorted by key)
+        lbl = '{job="progjob",namespace="default"}'
+        assert f"tpujob_training_steps_per_sec{lbl} 2" in body
+        assert f"tpujob_training_examples_per_sec{lbl} 64" in body
+        assert f"tpujob_training_step{lbl}" in body
         # step-time histogram with at least one observation at 0.5s
-        assert "tpujob_training_default_progjob_step_seconds_count" in body
-        assert 'tpujob_training_default_progjob_step_seconds_bucket' in body
+        assert f"tpujob_training_step_seconds_count{lbl}" in body
+        assert 'tpujob_training_step_seconds_bucket{job="progjob",namespace="default",le="0.5"}' in body
     finally:
         stop.set()
+        server.shutdown()
+
+
+# ----------------------------------------------- labeled-series surface --
+
+
+def test_labeled_exposition_escapes_quotes_backslashes_newlines():
+    m = Metrics()
+    m.inc("jobs_total", labels={"job": 'we"ird'})
+    m.set_gauge("depth", 2.0, labels={"path": "a\\b"})
+    m.observe("wait_seconds", 0.1, labels={"msg": "line1\nline2"})
+    text = m.prometheus_text()
+    assert 'jobs_total{job="we\\"ird"} 1.0' in text
+    assert 'depth{path="a\\\\b"} 2.0' in text
+    assert 'wait_seconds_count{msg="line1\\nline2"}' in text
+    # no raw newline may survive inside a label value (it would split the
+    # series line and corrupt the exposition)
+    for line in text.splitlines():
+        assert not line.startswith("line2")
+
+
+def test_labeled_series_are_independent_and_gc_by_label():
+    m = Metrics()
+    m.inc("pods_total", labels={"namespace": "a", "job": "x"})
+    m.inc("pods_total", 2.0, labels={"namespace": "a", "job": "y"})
+    m.inc("pods_total", 4.0)  # unlabeled sibling series
+    m.observe("step_seconds", 0.2, labels={"namespace": "a", "job": "x"})
+    assert m.get_counter("pods_total", {"namespace": "a", "job": "x"}) == 1.0
+    assert m.get_counter("pods_total", {"namespace": "a", "job": "y"}) == 2.0
+    removed = m.remove_labels({"namespace": "a", "job": "x"})
+    assert removed == 2  # the counter and the histogram
+    snap = m.snapshot()
+    assert 'pods_total{job="y",namespace="a"}' in snap["counters"]
+    assert "pods_total" in snap["counters"]  # unlabeled untouched
+    assert not any("x" in k for k in snap["histograms"])
+
+
+def test_help_lines_precede_type_lines():
+    m = Metrics()
+    m.describe("op.wait_seconds", "Time spent waiting.")
+    m.observe("op.wait_seconds", 0.01)
+    m.inc("op.undocumented_total")
+    lines = m.prometheus_text().splitlines()
+    hi = lines.index("# HELP op_wait_seconds Time spent waiting.")
+    ti = lines.index("# TYPE op_wait_seconds histogram")
+    assert hi == ti - 1
+    # undocumented metrics still expose TYPE without HELP
+    assert "# TYPE op_undocumented_total counter" in lines
+    assert not any("HELP op_undocumented" in ln for ln in lines)
+
+
+def test_events_endpoint_honors_key_and_reason_query():
+    opts = Options(workers=1)
+    server = Server(opts)
+    port = server.start_metrics_server(0)
+    try:
+        server.recorder.event("TPUJob", "default/a", "JobCreated", "m1")
+        server.recorder.event("TPUJob", "default/a", "JobSucceeded", "m2")
+        server.recorder.event("TPUJob", "default/b", "JobCreated", "m3")
+
+        def fetch(qs=""):
+            return json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/events{qs}", timeout=5
+                ).read()
+            )
+
+        assert len(fetch()) == 3
+        only_a = fetch("?key=default/a")
+        assert {e["key"] for e in only_a} == {"default/a"}
+        assert len(only_a) == 2
+        created = fetch("?reason=JobCreated")
+        assert {e["reason"] for e in created} == {"JobCreated"}
+        assert len(created) == 2
+        both = fetch("?key=default/b&reason=JobCreated")
+        assert len(both) == 1 and both[0]["message"] == "m3"
+        assert fetch("?key=default/b&reason=JobSucceeded") == []
+    finally:
+        server.shutdown()
+
+
+def test_workqueue_metrics_under_concurrent_workers():
+    from tfk8s_tpu.client.workqueue import RateLimitingQueue
+
+    m = Metrics()
+    q = RateLimitingQueue("conc", metrics=m)
+    n_items = 200
+    processed = []
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            item, shutdown = q.get()
+            if shutdown:
+                return
+            if item is None:
+                continue
+            with lock:
+                processed.append(item)
+            q.done(item)
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(n_items):
+        q.add(f"item-{i}")
+    assert wait_for(lambda: len(processed) == n_items)
+    q.shut_down()
+    for t in threads:
+        t.join(timeout=5)
+    snap = m.snapshot()
+    hist = snap["histograms"]['workqueue.queue_seconds{queue="conc"}']
+    assert hist["count"] == n_items  # one latency sample per dequeue
+    assert snap["gauges"]['workqueue.depth{queue="conc"}'] == 0.0
+
+
+def test_workqueue_requeue_counter_and_latency_handle():
+    from tfk8s_tpu.client.workqueue import RateLimitingQueue
+
+    m = Metrics()
+    q = RateLimitingQueue("rq", metrics=m)
+    q.add("k")
+    item, _ = q.get()
+    assert q.pop_queue_latency(item) is not None
+    assert q.pop_queue_latency(item) is None  # consumed
+    q.add("k")  # while processing -> dirty mark counts as a requeue
+    q.done("k")  # -> requeued
+    item2, _ = q.get()
+    assert item2 == "k"
+    q.done("k")
+    q.add_rate_limited("k")  # rate-limited retry counts too
+    assert m.get_counter(
+        "workqueue.requeues_total", {"queue": "rq"}
+    ) == 2.0
+    q.shut_down()
+
+
+def test_job_deletion_removes_exactly_its_labeled_series():
+    """Acceptance: /metrics exposes per-job labeled series and deleting a
+    job removes that job's series — and ONLY that job's."""
+    opts = Options(workers=1)
+    server = Server(opts)
+    stop = threading.Event()
+    port = server.start_metrics_server(0)
+    server.run(stop, block=False)
+    try:
+        from tfk8s_tpu.api.types import (
+            ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, TPUJob,
+            TPUJobSpec, TPUSpec,
+        )
+
+        for name in ("gcjob-a", "gcjob-b"):
+            server.clientset.tpujobs("default").create(
+                TPUJob(
+                    metadata=ObjectMeta(name=name),
+                    spec=TPUJobSpec(
+                        replica_specs={
+                            ReplicaType.WORKER: ReplicaSpec(
+                                replicas=1,
+                                template=ContainerSpec(
+                                    entrypoint="obstest.progress"
+                                ),
+                            )
+                        },
+                        tpu=TPUSpec(accelerator="cpu-1"),
+                    ),
+                )
+            )
+
+        def metrics_text():
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+
+        def series(name):
+            return f'tpujob_training_steps_per_sec{{job="{name}",namespace="default"}}'
+
+        assert wait_for(
+            lambda: series("gcjob-a") in metrics_text()
+            and series("gcjob-b") in metrics_text(),
+            timeout=60,
+        )
+        server.clientset.tpujobs("default").delete("gcjob-a")
+        assert wait_for(lambda: series("gcjob-a") not in metrics_text())
+        body = metrics_text()
+        assert series("gcjob-b") in body  # the neighbor survives
+        assert 'job="gcjob-a"' not in body  # histograms gone too
+    finally:
+        stop.set()
+        server.shutdown()
+
+
+def test_progress_slot_cleared_when_entrypoint_exits():
+    """Satellite: a completed pod's runtime/progress.py slot is cleared
+    when its entrypoint exits, so a reused thread ident cannot surface a
+    finished job's training numbers as someone else's."""
+    from tfk8s_tpu.api import helpers
+    from tfk8s_tpu.api.types import (
+        ContainerSpec, JobConditionType, ObjectMeta, ReplicaSpec,
+        ReplicaType, TPUJob, TPUJobSpec, TPUSpec,
+    )
+    from tfk8s_tpu.runtime import progress
+
+    opts = Options(workers=1)
+    server = Server(opts)
+    stop = threading.Event()
+    server.run(stop, block=False)
+    try:
+        server.clientset.tpujobs("default").create(
+            TPUJob(
+                metadata=ObjectMeta(name="progclear"),
+                spec=TPUJobSpec(
+                    replica_specs={
+                        ReplicaType.WORKER: ReplicaSpec(
+                            replicas=1,
+                            template=ContainerSpec(
+                                entrypoint="obstest.progress"
+                            ),
+                        )
+                    },
+                    tpu=TPUSpec(accelerator="cpu-1"),
+                ),
+            )
+        )
+        assert wait_for(
+            lambda: helpers.has_condition(
+                server.clientset.tpujobs("default").get("progclear").status,
+                JobConditionType.SUCCEEDED,
+            ),
+            timeout=60,
+        )
+
+        def no_stale_slots():
+            with progress._LOCK:
+                return not any(
+                    d.get("examples_per_sec") == 64.0
+                    for d in progress._BY_THREAD.values()
+                )
+
+        assert wait_for(no_stale_slots, timeout=10)
+    finally:
+        stop.set()
+        server.shutdown()
+
+
+def test_apiserver_per_verb_latency_metrics_and_exposition():
+    from tfk8s_tpu import API_VERSION
+    from tfk8s_tpu.client.apiserver import APIServer
+    from tfk8s_tpu.client.store import ClusterStore
+
+    m = Metrics()
+    server = APIServer(ClusterStore(), port=0, metrics=m)
+    server.serve_background()
+    try:
+        base = server.url
+        urllib.request.urlopen(
+            f"{base}/apis/{API_VERSION}/namespaces/default/pods", timeout=5
+        ).read()
+        urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+        assert m.get_counter("apiserver.requests_total", {"verb": "GET"}) >= 2
+        snap = m.snapshot()
+        hist = snap["histograms"]['apiserver.request_seconds{verb="GET"}']
+        assert hist["count"] >= 2
+        # the apiserver's own /metrics serves the exposition
+        text = urllib.request.urlopen(f"{base}/metrics", timeout=5).read().decode()
+        assert 'apiserver_request_seconds_bucket{verb="GET"' in text
+        assert "# HELP apiserver_request_seconds" in text
+    finally:
         server.shutdown()

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from tfk8s_tpu.models import bert, pipelined, t5
+from tfk8s_tpu.parallel._compat import jax_version_tuple
 from tfk8s_tpu.parallel import sharding as shd
 from tfk8s_tpu.parallel.mesh import make_mesh
 from tfk8s_tpu.parallel.moe import SwitchMoeBlock
@@ -147,6 +148,11 @@ class TestTop2Routing:
 
 
 class TestPipelinedFamily:
+    @pytest.mark.skipif(
+        jax_version_tuple() < (0, 5, 0),
+        reason="older XLA CPU cannot SPMD-partition PartitionId "
+               "(shard_map ppermute under jit)",
+    )
     def test_loss_decreases_on_pipeline_mesh(self):
         mesh = make_mesh(pipeline=2, data=2)
         cfg = bert.tiny_config(num_layers=2)

@@ -40,6 +40,14 @@ def test_with_batch_and_tensor_axes():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+from tfk8s_tpu.parallel._compat import jax_version_tuple
+
+
+@pytest.mark.skipif(
+    jax_version_tuple() < (0, 5, 0),
+    reason="older XLA CPU cannot SPMD-partition PartitionId (shard_map "
+           "ppermute under jit)",
+)
 def test_under_jit():
     mesh = make_mesh(sequence=8)
     q, k, v = _qkv(l=64)

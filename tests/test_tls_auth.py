@@ -15,6 +15,10 @@ import time
 
 import pytest
 
+# tlsutil generates certs with the cryptography package; without it this
+# module can't even import — skip instead of erroring at collection
+pytest.importorskip("cryptography")
+
 from tfk8s_tpu.api import helpers
 from tfk8s_tpu.api.types import (
     ContainerSpec,

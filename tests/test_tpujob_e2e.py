@@ -443,8 +443,8 @@ def test_capacity_gauges_exported(cluster):
                  entrypoint="test.block-until-stopped")
     cs.tpujobs().create(j)
     assert wait_for(lambda: job_has(cs, "gaugejob", JobConditionType.RUNNING))
-    assert ctrl.metrics.gauges.get("gang.free_slices.v5litepod-16") == 1.0
+    assert ctrl.metrics.get_gauge("gang.free_slices", {"accelerator": "v5litepod-16"}) == 1.0
     cs.tpujobs().delete("gaugejob")
     assert wait_for(
-        lambda: ctrl.metrics.gauges.get("gang.free_slices.v5litepod-16") == 2.0
+        lambda: ctrl.metrics.get_gauge("gang.free_slices", {"accelerator": "v5litepod-16"}) == 2.0
     )

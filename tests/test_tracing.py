@@ -1,0 +1,276 @@
+"""Tracing tests: Tracer unit behavior, traceparent propagation across
+the controller -> kubelet -> trainer boundary, and the acceptance e2e —
+one submitted TPUJob yields ONE trace at /traces whose root reconcile
+span is the ancestor of the trainer's first-step span, with pod-create
+and kubelet-launch spans in between (ISSUE 1 tentpole)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+from tfk8s_tpu.obs import trace as obstrace
+from tfk8s_tpu.obs.trace import TRACEPARENT_ENV, Tracer, parse_traceparent
+from tfk8s_tpu.runtime import registry
+
+from conftest import wait_for
+
+
+# ---------------------------------------------------------------- unit --
+
+
+def test_traceparent_roundtrip_and_rejection():
+    t = Tracer()
+    with t.start_span("root") as sp:
+        tp = sp.traceparent
+    assert parse_traceparent(tp) == (sp.trace_id, sp.span_id)
+    for bad in (None, "", "junk", "00-short-abc-01", "00-" + "g" * 32 + "-" + "0" * 16 + "-01"):
+        assert parse_traceparent(bad) is None
+
+
+def test_thread_local_nesting_and_parent_links():
+    t = Tracer()
+    with t.start_span("parent") as p:
+        assert t.current_span() is p
+        with t.start_span("child") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_id == p.span_id
+        with t.start_span("sibling") as s:
+            assert s.parent_id == p.span_id
+    assert t.current_span() is None
+    # new span after the stack drained starts a NEW trace
+    with t.start_span("other") as o:
+        assert o.trace_id != p.trace_id
+    names = {sp.name for sp in t.spans()}
+    assert names == {"parent", "child", "sibling", "other"}
+
+
+def test_traceparent_continues_trace_across_env_boundary():
+    """The controller→trainer handoff in miniature: a span's traceparent
+    carried through an env dict parents the continuation."""
+    t = Tracer()
+    with t.start_span("pod.create") as sp:
+        env = {TRACEPARENT_ENV: sp.traceparent}
+
+    def child_process():
+        with t.start_span("trainer.run", traceparent=env[TRACEPARENT_ENV]) as run:
+            assert run.trace_id == sp.trace_id
+            assert run.parent_id == sp.span_id
+
+    th = threading.Thread(target=child_process)
+    th.start()
+    th.join()
+    assert len(t.trace(sp.trace_id)) == 2
+
+
+def test_ring_is_bounded_and_error_status_recorded():
+    t = Tracer(capacity=8)
+    for i in range(20):
+        with t.start_span(f"s{i}"):
+            pass
+    assert len(t.spans()) == 8
+    try:
+        with t.start_span("boom"):
+            raise ValueError("no")
+    except ValueError:
+        pass
+    boom = t.find_spans("boom")[0]
+    assert boom.status == "error" and "no" in boom.message
+    # jsonl export round-trips
+    lines = t.to_jsonl().strip().split("\n")
+    assert len(lines) == 8
+    assert json.loads(lines[-1])["name"] == "boom"
+
+
+def test_disabled_tracer_is_inert():
+    t = Tracer(enabled=False)
+    with t.start_span("x") as sp:
+        sp.set_attribute("a", 1)
+        assert sp.traceparent == ""
+    assert t.spans() == []
+
+
+def test_record_span_retroactive():
+    t = Tracer()
+    with t.start_span("reconcile") as sp:
+        t.record_span("dequeue", start=sp.start_time - 0.25,
+                      end=sp.start_time, parent=sp)
+    dq = t.find_spans("dequeue")[0]
+    assert dq.trace_id == sp.trace_id and dq.parent_id == sp.span_id
+    assert abs((dq.end_time - dq.start_time) - 0.25) < 1e-9
+
+
+# ------------------------------------------------- controller handoff --
+
+DONE = {}
+
+
+@registry.register("tracetest.echo")
+def _echo(env):
+    DONE[env["TFK8S_JOB_NAME"]] = env.get(TRACEPARENT_ENV, "")
+
+
+def _make_job(name, entrypoint, env=None):
+    from tfk8s_tpu.api.types import (
+        ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, TPUJob,
+        TPUJobSpec, TPUSpec,
+    )
+
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1,
+                    template=ContainerSpec(
+                        entrypoint=entrypoint, env=dict(env or {})
+                    ),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+        ),
+    )
+
+
+def test_pod_stamped_with_traceparent_and_no_replace_churn():
+    """The creating sync stamps TFK8S_TRACEPARENT into the pod env; the
+    stamp parses, matches a recorded pod.create span, and — being
+    excluded from the contract-env diff — never triggers PodReplaced."""
+    from tfk8s_tpu.api import helpers
+    from tfk8s_tpu.api.types import JobConditionType
+    from tfk8s_tpu.client.fake import FakeClientset
+    from tfk8s_tpu.trainer.gang import SliceAllocator
+    from tfk8s_tpu.trainer.tpujob_controller import TPUJobController
+
+    tracer = Tracer()
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator(None), tracer=tracer)
+    stop = threading.Event()
+    assert ctrl.run(workers=1, stop=stop, block=False)
+    try:
+        cs.tpujobs("default").create(_make_job("stamp", "tracetest.echo"))
+        assert wait_for(
+            lambda: cs.pods("default").list()[0]
+            and len(cs.pods("default").list()[0]) == 1
+        )
+        pod = cs.pods("default").list()[0][0]
+        tp = pod.spec.containers[0].env.get(TRACEPARENT_ENV)
+        parsed = parse_traceparent(tp)
+        assert parsed is not None
+        trace_id, span_id = parsed
+        creates = [
+            s for s in tracer.find_spans("pod.create")
+            if s.span_id == span_id
+        ]
+        assert creates and creates[0].trace_id == trace_id
+        # that sync's trace has a reconcile root above the pod.create
+        by_id = {s.span_id: s for s in tracer.trace(trace_id)}
+        root = by_id[span_id]
+        while root.parent_id is not None:
+            root = by_id[root.parent_id]
+        assert root.name == "reconcile"
+        # several more syncs: the per-sync trace stamp must not read as a
+        # template edit (no PodReplaced, same pod uid)
+        uid = pod.metadata.uid
+        for _ in range(3):
+            ctrl.sync("default/stamp")
+        assert cs.pods("default").get(pod.metadata.name).metadata.uid == uid
+        assert not [
+            e for e in ctrl.recorder.events() if e.reason == "PodReplaced"
+        ]
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()
+
+
+# ------------------------------------------------------ acceptance e2e --
+
+
+@registry.register("tracetest.train")
+def _train(env, stop):
+    """Real (tiny) training through run_task so the trainer spans come
+    from the production path, not a stub."""
+    from tfk8s_tpu.models import mlp
+    from tfk8s_tpu.runtime.train import run_task
+
+    task = mlp.make_task(batch_size=8, hidden=16)
+    task.targets = {}  # 3 steps; convergence is not the point here
+    run_task(task, env, stop)
+
+
+def test_e2e_single_trace_reconcile_to_first_step():
+    """Acceptance: a submitted TPUJob yields one trace at /traces whose
+    root reconcile span (controller) is the ancestor of the trainer's
+    first-step span, through pod.create and kubelet.launch."""
+    from tfk8s_tpu.api import helpers
+    from tfk8s_tpu.api.types import JobConditionType
+    from tfk8s_tpu.cmd.options import Options
+    from tfk8s_tpu.cmd.server import Server
+
+    prev = obstrace.set_tracer(Tracer(capacity=16384))
+    stop = threading.Event()
+    server = None
+    try:
+        server = Server(Options(workers=1))
+        port = server.start_metrics_server(0)
+        server.run(stop, block=False)
+        server.clientset.tpujobs("default").create(
+            _make_job(
+                "tracejob", "tracetest.train",
+                env={"TFK8S_TRAIN_STEPS": "3", "TFK8S_LOG_EVERY": "1"},
+            )
+        )
+
+        def succeeded():
+            try:
+                cur = server.clientset.tpujobs("default").get("tracejob")
+            except Exception:
+                return False
+            return helpers.has_condition(
+                cur.status, JobConditionType.SUCCEEDED
+            )
+
+        assert wait_for(succeeded, timeout=120)
+
+        traces = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces", timeout=5
+            ).read()
+        )
+        # exactly one trace contains the trainer's first step — the one
+        # the creating reconcile started
+        first_step_traces = [
+            t for t in traces
+            if any(s["name"] == "trainer.first_step" for s in t["spans"])
+        ]
+        assert len(first_step_traces) == 1, [t["trace_id"] for t in first_step_traces]
+        spans = first_step_traces[0]["spans"]
+        by_id = {s["span_id"]: s for s in spans}
+        first = next(s for s in spans if s["name"] == "trainer.first_step")
+        # walk the ancestry chain up to the root
+        chain = [first["name"]]
+        cur = first
+        while cur["parent_id"] is not None:
+            cur = by_id[cur["parent_id"]]
+            chain.append(cur["name"])
+        assert chain[-1] == "reconcile", chain
+        assert "pod.create" in chain and "kubelet.launch" in chain, chain
+        assert "trainer.run" in chain, chain
+        # the compile split rode along as a child of first_step
+        compiles = [s for s in spans if s["name"] == "trainer.first_compile"]
+        assert compiles and compiles[0]["parent_id"] == first["span_id"]
+        # ?trace_id= narrows the endpoint to that single trace
+        only = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces?trace_id="
+                + first_step_traces[0]["trace_id"],
+                timeout=5,
+            ).read()
+        )
+        assert len(only) == 1
+        assert only[0]["trace_id"] == first_step_traces[0]["trace_id"]
+    finally:
+        stop.set()
+        if server is not None:
+            server.shutdown()
+        obstrace.set_tracer(prev)

@@ -54,6 +54,7 @@ import json
 import socketserver
 import ssl
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -289,11 +290,59 @@ class _Handler(BaseHTTPRequestHandler):
         return kind, ns, name, is_status, query
 
     # -- verbs --------------------------------------------------------------
+    #
+    # Each verb runs through _timed: with a metrics registry on the server,
+    # discrete requests record a per-verb latency histogram + counter
+    # (apiserver_request_seconds{verb=...}). Watch streams are excluded
+    # from the histogram — a stream lives for minutes and would bury the
+    # request latencies — and counted separately at stream open.
+
+    def _timed(self, verb: str, handler) -> None:
+        m = self.server.metrics
+        if m is None:
+            handler()
+            return
+        self._streaming = False
+        t0 = time.perf_counter()
+        try:
+            handler()
+        finally:
+            if not self._streaming:
+                labels = {"verb": verb}
+                m.observe(
+                    "apiserver.request_seconds",
+                    time.perf_counter() - t0, labels,
+                )
+                m.inc("apiserver.requests_total", 1.0, labels)
 
     def do_GET(self) -> None:
+        self._timed("GET", self._handle_get)
+
+    def do_POST(self) -> None:
+        self._timed("POST", self._handle_post)
+
+    def do_PUT(self) -> None:
+        self._timed("PUT", self._handle_put)
+
+    def do_PATCH(self) -> None:
+        self._timed("PATCH", self._handle_patch)
+
+    def do_DELETE(self) -> None:
+        self._timed("DELETE", self._handle_delete)
+
+    def _handle_get(self) -> None:
         if self.path == "/healthz":
             # liveness probes stay credential-free (kubelet-probe parity)
             self._send_json(200, {"status": "ok"})
+            return
+        if self.path == "/metrics" and self.server.metrics is not None:
+            # same open stance as /healthz: operator-internal plane
+            body = self.server.metrics.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         if self._gate(write=False) is None:
             return
@@ -347,7 +396,7 @@ class _Handler(BaseHTTPRequestHandler):
         if errs:
             raise _AdmissionRejected("; ".join(errs))
 
-    def do_POST(self) -> None:
+    def _handle_post(self) -> None:
         if self._gate(write=True) is None:
             return
         route = self._route()
@@ -365,7 +414,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
-    def do_PUT(self) -> None:
+    def _handle_put(self) -> None:
         if self._gate(write=True) is None:
             return
         route = self._route()
@@ -403,7 +452,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
-    def do_PATCH(self) -> None:
+    def _handle_patch(self) -> None:
         """JSON merge-patch (RFC 7386) on objects and /status — the verb
         `kubectl apply/scale` and controller status writes ride so
         concurrent writers touch disjoint fields instead of fighting over
@@ -440,7 +489,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send_store_error(e)
 
-    def do_DELETE(self) -> None:
+    def _handle_delete(self) -> None:
         if self._gate(write=True) is None:
             return
         route = self._route()
@@ -457,6 +506,11 @@ class _Handler(BaseHTTPRequestHandler):
     # -- watch streaming ----------------------------------------------------
 
     def _serve_watch(self, kind: str, query: Dict[str, str]) -> None:
+        self._streaming = True  # exclude the stream from request latency
+        if self.server.metrics is not None:
+            self.server.metrics.inc(
+                "apiserver.requests_total", 1.0, {"verb": "WATCH"}
+            )
         since_rv: Optional[int] = None
         if "resourceVersion" in query:
             since_rv = int(query["resourceVersion"])
@@ -514,10 +568,23 @@ class APIServer(ThreadingHTTPServer):
         admission: bool = True,
         tls: Optional[TLSServerConfig] = None,
         auth: Optional[AuthConfig] = None,
+        metrics=None,
     ):
         self.store = store
         self.admission = admission
         self.auth = auth
+        # optional utils.logging.Metrics: per-verb request latency
+        # histograms + /metrics exposition on this listener
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.describe(
+                "apiserver.request_seconds",
+                "Wall time per discrete apiserver request, by verb.",
+            )
+            metrics.describe(
+                "apiserver.requests_total",
+                "Requests served, by verb (WATCH counts stream opens).",
+            )
         self.stopping = threading.Event()
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if tls is not None:

@@ -109,12 +109,32 @@ class ResourceEventHandler:
 class SharedIndexInformer:
     """Reflector + indexer + handler dispatch for one kind."""
 
-    def __init__(self, client, resync_period: float = 0.0, name: str = ""):
+    def __init__(
+        self, client, resync_period: float = 0.0, name: str = "", metrics=None
+    ):
         """``client`` is a TypedClient-shaped object with ``list()`` and
-        ``watch(since_rv)`` — the ListWatch pair (k8s-operator.md:110-118)."""
+        ``watch(since_rv)`` — the ListWatch pair (k8s-operator.md:110-118).
+        With a ``metrics`` registry the informer counts delivered deltas
+        by type, resync sweeps, and relists, labeled
+        ``{informer="<name>"}`` — a relist storm or resync flood shows up
+        on /metrics instead of only in latency."""
         self._client = client
         self._resync_period = resync_period
         self.name = name or getattr(client, "kind", "informer")
+        self._metrics = metrics
+        if metrics is not None:
+            metrics.describe(
+                "informer.deltas_total",
+                "Watch/list deltas delivered to handlers, by type.",
+            )
+            metrics.describe(
+                "informer.resyncs_total",
+                "Periodic resync sweeps re-delivering the cached set.",
+            )
+            metrics.describe(
+                "informer.relists_total",
+                "Full relists (initial sync, 410 Gone, error recovery).",
+            )
         self.indexer = Indexer()
         self._handlers: List[ResourceEventHandler] = []
         self._synced = threading.Event()
@@ -151,12 +171,21 @@ class SharedIndexInformer:
 
     # -- handler dispatch ---------------------------------------------------
 
+    def _count_delta(self, delta_type: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(
+                "informer.deltas_total", 1.0,
+                {"informer": self.name, "type": delta_type},
+            )
+
     def _dispatch_add(self, obj: Any) -> None:
+        self._count_delta("add")
         for h in list(self._handlers):
             if h.on_add:
                 self._guard(h.on_add, copy.deepcopy(obj))
 
     def _dispatch_update(self, old: Any, new: Any) -> None:
+        self._count_delta("update")
         for h in list(self._handlers):
             if h.on_update:
                 self._guard(
@@ -166,6 +195,7 @@ class SharedIndexInformer:
                 )
 
     def _dispatch_delete(self, obj: Any) -> None:
+        self._count_delta("delete")
         for h in list(self._handlers):
             if h.on_delete:
                 self._guard(
@@ -189,6 +219,10 @@ class SharedIndexInformer:
         cached are delivered as updates (old, new) — not as adds — so
         update filters keep working across relists; objects that vanished
         during a watch gap are delivered as DeletedFinalStateUnknown."""
+        if self._metrics is not None:
+            self._metrics.inc(
+                "informer.relists_total", 1.0, {"informer": self.name}
+            )
         items, rv = self._client.list()
         old_objs = {k: self.indexer.get_by_key(k) for k in self.indexer.keys()}
         displaced = self.indexer.replace(items)
@@ -229,6 +263,11 @@ class SharedIndexInformer:
                             and time.monotonic() - last_resync > self._resync_period
                         ):
                             last_resync = time.monotonic()
+                            if self._metrics is not None:
+                                self._metrics.inc(
+                                    "informer.resyncs_total", 1.0,
+                                    {"informer": self.name},
+                                )
                             for obj in self.indexer.list():
                                 self._dispatch_update(obj, obj)
                         continue

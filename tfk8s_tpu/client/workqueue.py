@@ -15,6 +15,11 @@ k8s-operator.md:87,108):
   backoff, overall token bucket); ``forget`` resets an item's failure count.
 - **Shutdown**: ``shut_down()`` drains waiters; ``get()`` returns
   ``(None, True)`` — the ``queue.ShutDown()`` path (k8s-operator.md:200-202).
+- **Instrumentation** (the k8s workqueue MetricsProvider, optional): with
+  a ``metrics`` registry the queue exports depth (gauge), time-in-queue
+  (histogram, add→get per item), and requeues (counter), all labeled
+  ``{queue="<name>"}`` — the three numbers that tell a saturated control
+  plane apart from a slow one.
 """
 
 from __future__ import annotations
@@ -31,22 +36,52 @@ from tfk8s_tpu.client.ratelimit import MaxOfRateLimiter, default_controller_rate
 class WorkQueue:
     """FIFO with dedup + processing accounting."""
 
-    def __init__(self, name: str = ""):
+    def __init__(self, name: str = "", metrics=None):
         self.name = name
         self._cond = threading.Condition()
         self._queue: List[Hashable] = []
         self._dirty: Set[Hashable] = set()
         self._processing: Set[Hashable] = set()
         self._shutting_down = False
+        self._metrics = metrics
+        self._labels = {"queue": name or "default"}
+        # item -> monotonic add time (first add wins: a coalesced re-add
+        # must not reset the clock — the waiting work is the old one's)
+        self._added_at: dict = {}
+        # item -> the queue latency its most recent get() observed, for
+        # the controller's retroactive `dequeue` span
+        self._last_latency: dict = {}
+        if metrics is not None:
+            metrics.describe(
+                "workqueue.depth", "Items waiting in the work queue."
+            )
+            metrics.describe(
+                "workqueue.queue_seconds",
+                "Time an item waited in the queue before a worker took it.",
+            )
+            metrics.describe(
+                "workqueue.requeues_total",
+                "Items re-added while processing or via rate-limited retry.",
+            )
+
+    def _export_depth_locked(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "workqueue.depth", float(len(self._queue)), self._labels
+            )
 
     def add(self, item: Hashable) -> None:
         with self._cond:
             if self._shutting_down or item in self._dirty:
                 return
             self._dirty.add(item)
+            self._added_at.setdefault(item, time.monotonic())
             if item in self._processing:
+                if self._metrics is not None:
+                    self._metrics.inc("workqueue.requeues_total", 1.0, self._labels)
                 return  # will requeue on done()
             self._queue.append(item)
+            self._export_depth_locked()
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Tuple[Optional[Hashable], bool]:
@@ -65,13 +100,33 @@ class WorkQueue:
             item = self._queue.pop(0)
             self._processing.add(item)
             self._dirty.discard(item)
+            added = self._added_at.pop(item, None)
+            if added is not None:
+                latency = time.monotonic() - added
+                self._last_latency[item] = latency
+                if self._metrics is not None:
+                    self._metrics.observe(
+                        "workqueue.queue_seconds", latency, self._labels
+                    )
+            self._export_depth_locked()
             return item, False
+
+    def pop_queue_latency(self, item: Hashable) -> Optional[float]:
+        """Seconds the item just dequeued spent waiting (consumed on
+        read) — lets the caller attach the wait to its trace."""
+        with self._cond:
+            return self._last_latency.pop(item, None)
 
     def done(self, item: Hashable) -> None:
         with self._cond:
+            # unconsumed latency is stale once processing ends — drop it
+            # so the dict stays bounded by in-flight items
+            self._last_latency.pop(item, None)
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
+                self._added_at.setdefault(item, time.monotonic())
+                self._export_depth_locked()
                 self._cond.notify()
 
     def __len__(self) -> int:
@@ -93,8 +148,8 @@ class DelayingQueue(WorkQueue):
     """WorkQueue + ``add_after``: a background timer thread moves items into
     the queue when their delay expires."""
 
-    def __init__(self, name: str = ""):
-        super().__init__(name)
+    def __init__(self, name: str = "", metrics=None):
+        super().__init__(name, metrics=metrics)
         self._heap: List[Tuple[float, int, Hashable]] = []
         self._seq = itertools.count()
         self._timer_cond = threading.Condition()
@@ -128,11 +183,18 @@ class DelayingQueue(WorkQueue):
 class RateLimitingQueue(DelayingQueue):
     """The ``NewNamedRateLimitingQueue`` analogue."""
 
-    def __init__(self, name: str = "", rate_limiter: Optional[MaxOfRateLimiter] = None):
-        super().__init__(name)
+    def __init__(
+        self,
+        name: str = "",
+        rate_limiter: Optional[MaxOfRateLimiter] = None,
+        metrics=None,
+    ):
+        super().__init__(name, metrics=metrics)
         self.rate_limiter = rate_limiter or default_controller_rate_limiter()
 
     def add_rate_limited(self, item: Hashable) -> None:
+        if self._metrics is not None:
+            self._metrics.inc("workqueue.requeues_total", 1.0, self._labels)
         self.add_after(item, self.rate_limiter.when(item))
 
     def forget(self, item: Hashable) -> None:

@@ -339,7 +339,12 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
         log.info(
             "journal: %s (replayed to rv %d)", args.journal_dir, store.resource_version
         )
-    server = APIServer(store, host=args.host, port=args.port, tls=tls, auth=auth)
+    from tfk8s_tpu.utils.logging import Metrics
+
+    server = APIServer(
+        store, host=args.host, port=args.port, tls=tls, auth=auth,
+        metrics=Metrics(),
+    )
     if args.write_kubeconfig:
         kc: dict = {"server": server.url}
         if ca_pem:

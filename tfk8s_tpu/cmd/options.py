@@ -42,7 +42,8 @@ class Options:
     # in-process store (the reference's kubeconfig flag,
     # k8s-operator.md:206-207)
     kubeconfig: str = ""
-    # observability endpoint (/metrics, /healthz, /events); 0 = disabled
+    # observability endpoint (/metrics, /healthz, /events, /traces);
+    # 0 = disabled
     metrics_port: int = 0
     # logging
     log_level: str = "info"
@@ -84,7 +85,8 @@ class Options:
                        help="kubeconfig JSON path; talk to a remote "
                        "apiserver instead of the in-process store")
         g.add_argument("--metrics-port", type=int, default=0, dest="metrics_port",
-                       help="serve /metrics, /healthz, /events on this port (0=off)")
+                       help="serve /metrics, /healthz, /events, /traces "
+                            "on this port (0=off)")
         g.add_argument("--log-level", default="info",
                        choices=["debug", "info", "warning", "error"])
 

@@ -14,12 +14,14 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import urllib.parse
 from typing import Optional
 
 from tfk8s_tpu.client.clientset import Clientset, RESTConfig
 from tfk8s_tpu.client.store import ClusterStore
 from tfk8s_tpu.controller.leaderelection import LeaderElector
 from tfk8s_tpu.cmd.options import Options
+from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.runtime.kubelet import LocalKubelet
 from tfk8s_tpu.trainer.gang import SliceAllocator
 from tfk8s_tpu.trainer.tpujob_controller import TPUJobController
@@ -33,6 +35,12 @@ class Server:
 
     def __init__(self, opts: Options, store: Optional[ClusterStore] = None):
         self.opts = opts
+        # ALWAYS the process-default tracer: the kubelet and trainer
+        # threads resolve get_tracer() themselves, so only the global
+        # ring can hold the whole reconcile→pod→kubelet→trainer chain
+        # /traces advertises. Isolation (tests) swaps the global via
+        # obs.trace.set_tracer, never per-Server.
+        self.tracer = get_tracer()
         qps, burst = opts.qps, opts.burst
         if store is not None:
             self.store = store
@@ -62,13 +70,14 @@ class Server:
             recorder=self.recorder,
             metrics=self.metrics,
             resync_period=opts.resync_period_s,
+            tracer=self.tracer,
         )
         self.kubelet = LocalKubelet(self.clientset) if opts.local_kubelet else None
         self._threads: list = []
         self._http: Optional[http.server.ThreadingHTTPServer] = None
 
     # -- observability endpoint (SURVEY.md §5: absent in the reference;
-    #    /metrics Prometheus text, /healthz, /events JSON) ---------------
+    #    /metrics Prometheus text, /healthz, /events JSON, /traces JSON) --
 
     def start_metrics_server(self, port: int) -> int:
         """Bind and serve on a daemon thread; returns the bound port
@@ -80,20 +89,47 @@ class Server:
                 pass
 
             def do_GET(self):
-                if self.path == "/metrics":
+                parsed = urllib.parse.urlparse(self.path)
+                query = {
+                    k: v[0]
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                path = parsed.path
+                if path == "/metrics":
                     body = server.metrics.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path == "/healthz":
+                elif path == "/healthz":
                     body = b"ok"
                     ctype = "text/plain"
-                elif self.path == "/events":
+                elif path == "/events":
+                    # ?key=<ns/name> and ?reason=<reason> filter
+                    # server-side (EventRecorder.events already takes
+                    # both; the handler forwards the query string)
                     body = json.dumps(
                         [
                             {
                                 "ts": e.timestamp, "kind": e.kind, "key": e.key,
                                 "reason": e.reason, "message": e.message,
                             }
-                            for e in server.recorder.events()
+                            for e in server.recorder.events(
+                                key=query.get("key"),
+                                reason=query.get("reason"),
+                            )
+                        ]
+                    ).encode()
+                    ctype = "application/json"
+                elif path == "/traces":
+                    # one JSON object per trace, spans in start order;
+                    # ?trace_id= narrows to one trace
+                    want = query.get("trace_id")
+                    body = json.dumps(
+                        [
+                            {
+                                "trace_id": tid,
+                                "spans": [s.to_dict() for s in spans],
+                            }
+                            for tid, spans in server.tracer.traces().items()
+                            if want is None or tid == want
                         ]
                     ).encode()
                     ctype = "application/json"

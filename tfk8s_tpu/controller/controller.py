@@ -31,6 +31,7 @@ from tfk8s_tpu.client.informer import (
     wait_for_cache_sync,
 )
 from tfk8s_tpu.client.workqueue import RateLimitingQueue
+from tfk8s_tpu.obs.trace import Tracer, get_tracer
 from tfk8s_tpu.utils.logging import EventRecorder, Metrics, get_logger
 
 log = get_logger("controller")
@@ -48,15 +49,26 @@ class Controller:
         recorder: Optional[EventRecorder] = None,
         metrics: Optional[Metrics] = None,
         kind: str = "",
+        tracer: Optional[Tracer] = None,
     ):
         self.name = name
         self.kind = kind or name
         self.sync = sync
         self.informers = list(informers)
-        self.queue = RateLimitingQueue(name)
         self.max_retries = max_retries
         self.recorder = recorder or EventRecorder()
         self.metrics = metrics or Metrics()
+        self.tracer = tracer or get_tracer()
+        self.queue = RateLimitingQueue(name, metrics=self.metrics)
+        self.metrics.describe(
+            f"{name}.syncs_total", "Successful reconcile passes."
+        )
+        self.metrics.describe(
+            f"{name}.sync_errors_total", "Reconcile passes that raised."
+        )
+        self.metrics.describe(
+            f"{name}.sync_seconds", "Wall time of one reconcile pass."
+        )
         self._workers: List[threading.Thread] = []
 
     # -- enqueue paths (k8s-operator.md:132-150) ----------------------------
@@ -132,32 +144,50 @@ class Controller:
                 return
             if key is None:
                 continue
+            # time-in-queue, measured by the queue at dequeue — recorded
+            # retroactively as the reconcile trace's first child so the
+            # trace shows waiting separately from working
+            qlat = self.queue.pop_queue_latency(key)
             t0 = time.perf_counter()
-            try:
-                self.sync(key)
-            except Exception as e:  # noqa: BLE001 — one bad key must not kill the worker
-                self.metrics.inc(f"{self.name}.sync_errors")
-                retries = self.queue.num_requeues(key)
-                if retries < self.max_retries:
-                    log.warning(
-                        "%s: sync %s failed (retry %d/%d): %s",
-                        self.name, key, retries + 1, self.max_retries, e,
+            with self.tracer.start_span(
+                "reconcile",
+                attributes={"controller": self.name, "key": str(key)},
+            ) as span:
+                if qlat is not None:
+                    self.tracer.record_span(
+                        "dequeue",
+                        start=span.start_time - qlat,
+                        end=span.start_time,
+                        parent=span,
+                        attributes={"queue": self.name},
                     )
-                    self.queue.add_rate_limited(key)
+                try:
+                    self.sync(key)
+                except Exception as e:  # noqa: BLE001 — one bad key must not kill the worker
+                    span.set_status("error", f"{type(e).__name__}: {e}")
+                    self.metrics.inc(f"{self.name}.sync_errors_total")
+                    retries = self.queue.num_requeues(key)
+                    if retries < self.max_retries:
+                        log.warning(
+                            "%s: sync %s failed (retry %d/%d): %s",
+                            self.name, key, retries + 1, self.max_retries, e,
+                        )
+                        self.queue.add_rate_limited(key)
+                    else:
+                        log.error(
+                            "%s: sync %s dropped after %d retries:\n%s",
+                            self.name, key, retries, traceback.format_exc(),
+                        )
+                        self.recorder.event(
+                            self.kind, key, "SyncDropped",
+                            f"gave up after {retries} retries: {e}",
+                        )
+                        self.queue.forget(key)
                 else:
-                    log.error(
-                        "%s: sync %s dropped after %d retries:\n%s",
-                        self.name, key, retries, traceback.format_exc(),
-                    )
-                    self.recorder.event(
-                        self.kind, key, "SyncDropped", f"gave up after {retries} retries: {e}"
-                    )
+                    self.metrics.inc(f"{self.name}.syncs_total")
                     self.queue.forget(key)
-            else:
-                self.metrics.inc(f"{self.name}.syncs")
-                self.queue.forget(key)
-            finally:
-                self.metrics.observe(
-                    f"{self.name}.sync_seconds", time.perf_counter() - t0
-                )
-                self.queue.done(key)
+                finally:
+                    self.metrics.observe(
+                        f"{self.name}.sync_seconds", time.perf_counter() - t0
+                    )
+                    self.queue.done(key)

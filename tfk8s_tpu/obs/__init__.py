@@ -1,0 +1,14 @@
+"""Observability subsystem: causal tracing across the controller →
+kubelet → trainer boundary (obs/trace.py), feeding the /traces endpoint
+on the operator server. Metrics live in utils/logging.Metrics (labeled
+series + Prometheus exposition); this package owns the trace model.
+"""
+
+from tfk8s_tpu.obs.trace import (  # noqa: F401
+    TRACEPARENT_ENV,
+    Span,
+    Tracer,
+    get_tracer,
+    parse_traceparent,
+    set_tracer,
+)

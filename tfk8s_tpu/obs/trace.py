@@ -1,0 +1,359 @@
+"""Causal tracing for the control plane and the training runtime.
+
+One trace follows one piece of work across process-role boundaries: the
+controller opens a ``reconcile`` root span per sync, stamps the pod spec
+with a ``TFK8S_TRACEPARENT`` env var at pod creation, the kubelet
+continues that trace around the entrypoint launch, and the trainer adds
+``trainer.*`` spans for startup, first compile, and the first optimizer
+step — CRD update to step 1, one trace (PAPER.md §5 marks every such
+subsystem ABSENT in the reference; this is the build's addition).
+
+Model (a deliberately small slice of W3C trace-context + OTel):
+
+- ``Span``: trace_id (32 hex) / span_id (16 hex) / parent_id, name,
+  wall-clock start/end, string attributes, ok|error status.
+- Propagation: ``span.traceparent`` renders the W3C header form
+  (``00-<trace_id>-<span_id>-01``); :func:`parse_traceparent` reverses
+  it. In-process, a thread-local stack makes nested ``start_span`` calls
+  parent automatically — the hermetic kubelet runs entrypoints on
+  threads, so the trainer's spans nest under the kubelet's without any
+  plumbing; across a real process boundary the env var carries the link.
+- Storage: finished spans land in a bounded ring (old traces are evicted,
+  the tracer never grows without bound) served by ``/traces`` on the
+  operator server and exportable as JSONL for offline tooling.
+
+The module-level default tracer is what production wiring uses, so the
+controller, kubelet, and trainer threads of one process share one ring;
+tests can isolate with ``set_tracer`` or by passing explicit tracers.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# Pod-env key carrying the parent span across the control->data plane
+# handoff (trainer/replicas.py renders env; the controller stamps this
+# one at pod creation because only the creating sync knows its span).
+TRACEPARENT_ENV = "TFK8S_TRACEPARENT"
+
+_TRACEPARENT_VERSION = "00"
+
+
+def _gen_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str]]:
+    """``00-<trace_id>-<span_id>-<flags>`` -> (trace_id, span_id), or None
+    for anything malformed — a bad header must degrade to 'new trace',
+    never to a crash in the reconcile or training path."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _ver, trace_id, span_id, _flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return trace_id, span_id
+
+
+@dataclasses.dataclass
+class Span:
+    """One timed operation. Context-manager: ``with tracer.start_span(..)``
+    pops the thread-local stack and lands the span in the ring on exit."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    start_time: float = 0.0
+    end_time: Optional[float] = None
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    status: str = "ok"
+    message: str = ""
+    _tracer: Optional["Tracer"] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    @property
+    def traceparent(self) -> str:
+        return f"{_TRACEPARENT_VERSION}-{self.trace_id}-{self.span_id}-01"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def set_status(self, status: str, message: str = "") -> None:
+        self.status = status
+        self.message = message
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "duration_s": (
+                None if self.end_time is None
+                else self.end_time - self.start_time
+            ),
+            "attributes": dict(self.attributes),
+            "status": self.status,
+            "message": self.message,
+        }
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> bool:
+        if exc is not None and self.status == "ok":
+            self.set_status("error", f"{getattr(exc_type, '__name__', exc_type)}: {exc}")
+        if self._tracer is not None:
+            self._tracer._finish(self)
+        return False
+
+
+class _NoopSpan:
+    """Returned by a disabled tracer: every operation is a no-op and the
+    span never touches a lock — the bench's 'instrumentation off' arm."""
+
+    name = ""
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    start_time = 0.0
+    end_time = 0.0
+    attributes: Dict[str, Any] = {}
+    status = "ok"
+    traceparent = ""
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def set_status(self, status: str, message: str = "") -> None:
+        pass
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Thread-safe span factory + bounded in-memory ring of finished
+    spans. ``capacity`` bounds memory: a long-lived operator keeps the
+    most recent ~capacity spans, oldest evicted."""
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        # ring of (seq, span): the monotonically-increasing seq lets
+        # export_jsonl write each span exactly once across repeated calls
+        self._spans: "collections.deque" = collections.deque(maxlen=capacity)
+        self._next_seq = 0
+        self._exported_seq = -1
+        self._tls = threading.local()
+
+    # -- context -----------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def current_span(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def current_traceparent(self) -> Optional[str]:
+        sp = self.current_span()
+        return sp.traceparent if sp is not None else None
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def start_span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        traceparent: Optional[str] = None,
+        attributes: Optional[Dict[str, Any]] = None,
+    ) -> Span:
+        """Open a span. Parent resolution: explicit ``parent`` span >
+        the calling thread's current span > ``traceparent`` header > new
+        root (fresh trace_id). Thread context outranks the header on
+        purpose: in the hermetic deployment the pod thread's ambient span
+        (kubelet.launch) is already a continuation of the trace the
+        header names, one hop deeper — the header is the cross-PROCESS
+        fallback where no ambient context can exist."""
+        if not self.enabled:
+            return _NOOP_SPAN  # type: ignore[return-value]
+        parent_id: Optional[str] = None
+        trace_id: Optional[str] = None
+        if parent is not None and parent.trace_id:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        if trace_id is None:
+            cur = self.current_span()
+            if cur is not None:
+                trace_id, parent_id = cur.trace_id, cur.span_id
+        if trace_id is None and traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed is not None:
+                trace_id, parent_id = parsed
+        if trace_id is None:
+            trace_id = _gen_id(16)
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_gen_id(8),
+            parent_id=parent_id,
+            start_time=time.time(),
+            attributes=dict(attributes or {}),
+            _tracer=self,
+        )
+        self._stack().append(span)
+        return span
+
+    def _finish(self, span: Span) -> None:
+        if span.end_time is None:
+            span.end_time = time.time()
+        st = self._stack()
+        for i, s in enumerate(st):  # pop it and anything leaked above it
+            if s is span:
+                del st[i:]
+                break
+        self._append(span)
+
+    def _append(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append((self._next_seq, span))
+            self._next_seq += 1
+
+    def record_span(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        parent: Optional[Span] = None,
+        traceparent: Optional[str] = None,
+        attributes: Optional[Dict[str, Any]] = None,
+        status: str = "ok",
+    ) -> Span:
+        """Record an already-elapsed interval (e.g. the measured
+        time-in-queue before a reconcile span existed) without touching
+        the thread-local stack."""
+        if not self.enabled:
+            return _NOOP_SPAN  # type: ignore[return-value]
+        parent_id: Optional[str] = None
+        trace_id: Optional[str] = None
+        if parent is not None and parent.trace_id:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed is not None:
+                trace_id, parent_id = parsed
+        if trace_id is None:
+            trace_id = _gen_id(16)
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_gen_id(8),
+            parent_id=parent_id,
+            start_time=start,
+            end_time=end,
+            attributes=dict(attributes or {}),
+            status=status,
+        )
+        self._append(span)
+        return span
+
+    # -- read side ---------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return [s for _seq, s in self._spans]
+
+    def traces(self) -> Dict[str, List[Span]]:
+        """trace_id -> spans sorted by start time, traces ordered by their
+        earliest span (oldest trace first)."""
+        by_trace: Dict[str, List[Span]] = {}
+        for sp in self.spans():
+            by_trace.setdefault(sp.trace_id, []).append(sp)
+        out: Dict[str, List[Span]] = {}
+        for tid, sps in sorted(
+            by_trace.items(), key=lambda kv: min(s.start_time for s in kv[1])
+        ):
+            out[tid] = sorted(sps, key=lambda s: s.start_time)
+        return out
+
+    def trace(self, trace_id: str) -> List[Span]:
+        return sorted(
+            (s for s in self.spans() if s.trace_id == trace_id),
+            key=lambda s: s.start_time,
+        )
+
+    def find_spans(self, name: str) -> List[Span]:
+        return [s for s in self.spans() if s.name == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- export ------------------------------------------------------------
+
+    def to_jsonl(self) -> str:
+        return "".join(json.dumps(s.to_dict()) + "\n" for s in self.spans())
+
+    def export_jsonl(self, path: str) -> int:
+        """Append spans not yet exported to ``path`` (each span is written
+        exactly once across repeated calls — periodic exporters must not
+        duplicate the still-buffered ring); returns the count written."""
+        with self._lock:
+            fresh = [(seq, s) for seq, s in self._spans if seq > self._exported_seq]
+        if not fresh:
+            return 0
+        with open(path, "a") as f:
+            for _seq, s in fresh:
+                f.write(json.dumps(s.to_dict()) + "\n")
+        with self._lock:
+            self._exported_seq = max(self._exported_seq, fresh[-1][0])
+        return len(fresh)
+
+
+_default_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer production wiring shares (controller,
+    kubelet, and trainer threads of one hermetic process land their spans
+    in the same ring, which is what makes the single e2e trace real)."""
+    return _default_tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process default (tests / bench isolation); returns the
+    previous one so callers can restore it."""
+    global _default_tracer
+    prev = _default_tracer
+    _default_tracer = tracer
+    return prev

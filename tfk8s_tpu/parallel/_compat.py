@@ -1,0 +1,41 @@
+"""jax compatibility shims for the parallel package.
+
+The code targets current jax (``jax.shard_map`` with ``check_vma``); on
+older installs (pre-0.6) shard_map still lives in ``jax.experimental``
+and the kwarg is named ``check_rep`` — translate both here, once, so the
+five call sites stay written against the modern API.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+def jax_version_tuple() -> tuple:
+    """``jax.__version__`` as a comparable (major, minor, patch) tuple,
+    tolerant of pre-release suffixes ('0.5.0rc1' -> (0, 5, 0)) — naive
+    int() parsing crashes on them. The one shared copy for every
+    version-gated shim and test skip."""
+    import jax
+
+    parts = []
+    for piece in jax.__version__.split(".")[:3]:
+        m = re.match(r"\d+", piece)
+        parts.append(int(m.group()) if m else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts)
+
+
+try:
+    from jax import shard_map  # modern home (jax >= 0.6)
+except ImportError:  # pragma: no cover - exercised on older jax only
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(*args, **kwargs)

@@ -26,7 +26,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from tfk8s_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tfk8s_tpu.parallel.mesh import AXIS_PIPELINE

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from tfk8s_tpu.parallel._compat import shard_map
 
 from tfk8s_tpu.parallel.mesh import (
     AXIS_DATA,

@@ -35,7 +35,7 @@ from typing import Callable, Optional
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from tfk8s_tpu.parallel._compat import shard_map
 
 from tfk8s_tpu.parallel.mesh import (
     AXIS_DATA,

@@ -29,6 +29,7 @@ from tfk8s_tpu.api.types import Pod, PodPhase
 from tfk8s_tpu.client.clientset import Clientset
 from tfk8s_tpu.client.informer import ResourceEventHandler, SharedIndexInformer
 from tfk8s_tpu.client.store import Conflict, NotFound, Unavailable
+from tfk8s_tpu.obs.trace import TRACEPARENT_ENV, get_tracer
 from tfk8s_tpu.runtime import progress as _progress
 from tfk8s_tpu.runtime import registry
 from tfk8s_tpu.utils.logging import get_logger
@@ -391,46 +392,62 @@ class LocalKubelet:
         key, uid = pod.metadata.key, pod.metadata.uid
         ident = threading.get_ident()
         buf = self._log_router.register(ident)
+        # Thread idents are REUSED by the OS: a progress slot leaked by a
+        # previous occupant of this ident (e.g. a direct run_task outside
+        # any kubelet) must not surface as THIS pod's training progress
+        # until its first real report.
+        _progress.clear(ident)
         with self._lock:
             self._log_bufs[(key, uid)] = buf
             self._progress_idents[(key, uid)] = ident
+        # Continue the trace the creating controller sync stamped into the
+        # pod env (obs/trace.py): the launch span is the bridge between
+        # the reconcile spans and the trainer's spans. The env copy is
+        # shared with the entrypoint call; a malformed spec (no
+        # containers) leaves it empty here and fails inside the span,
+        # where the ordinary FAILED path records it.
         try:
-            container = pod.spec.containers[0]
-            env = dict(container.env)
-            # test-only failure injection
-            fail_times = int(env.get("TFK8S_TEST_FAIL_TIMES", "0"))
-            if not self._set_phase(key, uid, PodPhase.RUNNING):
-                return
-            if fail_times:
-                with self._lock:
-                    n = self._fail_counts.get(pod.metadata.name, 0)
-                    self._fail_counts[pod.metadata.name] = n + 1
-                if n < fail_times:
-                    raise RuntimeError(f"injected failure {n + 1}/{fail_times}")
-            fn = registry.resolve(container.entrypoint)
-            registry.call(fn, env, pod_stop)
-            # the terminal write carries the FINAL progress report too —
-            # the 1s flusher usually misses the report fired right before
-            # the entrypoint returns (e.g. the step==steps boundary)
-            self._set_phase(
-                key, uid, PodPhase.SUCCEEDED, exit_code=0,
-                log_tail=list(buf), training=_progress.snapshot(ident),
-            )
-        except Exception as e:  # noqa: BLE001 — container failure, not ours
+            env = dict(pod.spec.containers[0].env)
+        except Exception:  # noqa: BLE001
+            env = {}
+        span = get_tracer().start_span(
+            "kubelet.launch",
+            traceparent=env.get(TRACEPARENT_ENV),
+            attributes={"pod": key, "node": self.name},
+        )
+        try:
+            with span:
+                container = pod.spec.containers[0]
+                # test-only failure injection
+                fail_times = int(env.get("TFK8S_TEST_FAIL_TIMES", "0"))
+                if not self._set_phase(key, uid, PodPhase.RUNNING):
+                    return
+                if fail_times:
+                    with self._lock:
+                        n = self._fail_counts.get(pod.metadata.name, 0)
+                        self._fail_counts[pod.metadata.name] = n + 1
+                    if n < fail_times:
+                        raise RuntimeError(
+                            f"injected failure {n + 1}/{fail_times}"
+                        )
+                fn = registry.resolve(container.entrypoint)
+                registry.call(fn, env, pod_stop)
+                # the terminal write carries the FINAL progress report too
+                # — the 1s flusher usually misses the report fired right
+                # before the entrypoint returns (the step==steps boundary)
+                self._set_phase(
+                    key, uid, PodPhase.SUCCEEDED, exit_code=0,
+                    log_tail=list(buf), training=_progress.snapshot(ident),
+                )
+        except Exception as e:  # noqa: BLE001 — container or kubelet failure
             log.info("%s: pod %s failed: %s", self.name, key, e)
             try:
                 self._set_phase(
-                    key,
-                    uid,
-                    PodPhase.FAILED,
-                    message=f"{type(e).__name__}: {e}",
-                    exit_code=1,
-                    log_tail=list(buf),
-                    training=_progress.snapshot(ident),
+                    key, uid, PodPhase.FAILED,
+                    message=f"{type(e).__name__}: {e}", exit_code=1,
+                    log_tail=list(buf), training=_progress.snapshot(ident),
                 )
-            except Exception:  # noqa: BLE001 — apiserver gone (teardown):
-                # the node lease will go stale and the controller (if any
-                # is left) marks the pod NodeLost; nothing more to do here
+            except Exception:  # noqa: BLE001 — apiserver gone (teardown)
                 log.debug("%s: terminal status write for %s failed:\n%s",
                           self.name, key, traceback.format_exc())
             log.debug("%s", traceback.format_exc())

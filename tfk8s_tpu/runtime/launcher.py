@@ -93,11 +93,16 @@ def force_platform(platform: str, num_devices: Optional[int] = None) -> bool:
             ).strip()
     try:
         jax.config.update("jax_platforms", platform)
-        if num_devices is not None and platform == "cpu":
-            jax.config.update("jax_num_cpu_devices", int(num_devices))
-        return True
     except Exception:  # backend already initialized
         return False
+    if num_devices is not None and platform == "cpu":
+        try:
+            jax.config.update("jax_num_cpu_devices", int(num_devices))
+        except Exception:
+            # older jax has no jax_num_cpu_devices; the XLA_FLAGS
+            # host-device-count flag set above provisions the devices
+            pass
+    return True
 
 
 def initialize_distributed(ctx: ProcessContext, env: Optional[Dict[str, str]] = None) -> None:

@@ -35,6 +35,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tfk8s_tpu.obs.trace import TRACEPARENT_ENV, get_tracer
 from tfk8s_tpu.parallel import sharding as shd
 from tfk8s_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, MeshConfig
 from tfk8s_tpu.runtime import progress
@@ -43,6 +44,12 @@ from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_di
 from tfk8s_tpu.utils.logging import get_logger
 
 log = get_logger("train")
+
+# A step counts as input-starved when the host wait for its batch exceeds
+# this fraction of the step's wall time — the device sat idle waiting on
+# input synthesis/IO rather than compute (the alert the windowed
+# input_mb_per_sec report exists to explain).
+_INPUT_STARVED_FRACTION = 0.2
 
 
 class TrainState(struct.PyTreeNode):
@@ -916,12 +923,47 @@ class Trainer:
                 else _make_host_batch(step)
             )
 
+        tracer = get_tracer()
+        first_dispatch = True
+        compile_s: Optional[float] = None
+        input_wait_total = 0.0  # cumulative host wait for batches
+        starved_steps = 0  # steps whose input wait dominated the loop
+
+        def _dispatch(call):
+            """Run one device dispatch; the FIRST one is wrapped in
+            trainer.first_step / trainer.first_compile spans and fetched
+            to completion — the compile-vs-execute split of step 1 is the
+            number cold-start debugging needs, and the spans are the tail
+            of the reconcile→pod→kubelet trace (obs/trace.py)."""
+            nonlocal first_dispatch, compile_s
+            if not first_dispatch:
+                return call()
+            first_dispatch = False
+            with tracer.start_span(
+                "trainer.first_step", attributes={"task": self.task.name}
+            ):
+                c0 = time.perf_counter()
+                with tracer.start_span("trainer.first_compile"):
+                    # trace+compile run synchronously inside the first
+                    # call; execution is enqueued async
+                    out = call()
+                compile_s = time.perf_counter() - c0
+                # fetch one metric leaf so the span covers the step's real
+                # execution, not just its enqueue (block_until_ready
+                # returns early through the remote tunnel)
+                leaves = jax.tree_util.tree_leaves(out[1])
+                if leaves:
+                    np.asarray(leaves[0])
+            progress.report(compile_seconds=compile_s)
+            return out
+
         try:
             step = start_step
             while step < cfg.steps:
                 if stop is not None and getattr(stop, "is_set", lambda: False)():
                     log.info("%s: stop requested at step %d", self.task.name, step)
                     break
+                it_t0 = time.perf_counter()
                 if step == prof_start:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
@@ -930,17 +972,26 @@ class Trainer:
                 if ckpt and cfg.checkpoint_every:
                     k = min(k, cfg.checkpoint_every - step % cfg.checkpoint_every)
                 if k == 1:
+                    t_in = time.perf_counter()
+                    host = _next_batch(step)
+                    input_wait = time.perf_counter() - t_in
                     # device transfer stays on THIS thread (see
                     # _BatchPrefetcher); it is an async enqueue
-                    batch = self._put_global(_next_batch(step), batch_shardings)
-                    state, metrics = self._step_fn(state, batch, base_key)
+                    batch = self._put_global(host, batch_shardings)
+                    state, metrics = _dispatch(
+                        lambda: self._step_fn(state, batch, base_key)
+                    )
                 else:
+                    t_in = time.perf_counter()
+                    hosts = [_next_batch(step + i) for i in range(k)]
+                    input_wait = time.perf_counter() - t_in
                     stacked = jax.tree_util.tree_map(
-                        lambda *xs: np.stack(xs),
-                        *[_next_batch(step + i) for i in range(k)],
+                        lambda *xs: np.stack(xs), *hosts
                     )
                     batch = self._put_global(stacked, stacked_shardings, stack=k)
-                    state, ys = self._chunk_fn(k)(state, batch, base_key)
+                    state, ys = _dispatch(
+                        lambda: self._chunk_fn(k)(state, batch, base_key)
+                    )
                     metrics = jax.tree_util.tree_map(lambda x: x[-1], ys)
                 step += k
                 # the window counts STEPS, not dispatches: a k-step chunk
@@ -963,6 +1014,14 @@ class Trainer:
                         inflight_steps -= w
                     if newest is not None:
                         float(newest)
+                # input-starvation accounting: compare the host wait for
+                # this iteration's batch(es) against the whole iteration
+                # (including any inflight drain — the steady-state step
+                # cost). A dominating wait means the device idled on input.
+                it_dt = time.perf_counter() - it_t0
+                input_wait_total += input_wait
+                if input_wait > _INPUT_STARVED_FRACTION * max(it_dt, 1e-9):
+                    starved_steps += k
                 if profiling and step >= prof_stop:
                     float(metrics["loss"])  # honest drain before stopping
                     jax.profiler.stop_trace()
@@ -990,7 +1049,14 @@ class Trainer:
                         steps_per_sec=rate,
                         examples_per_sec=rate * self.task.batch_size,
                         step_seconds=w_dt / w_steps,
+                        # cumulative input health: total host wait for
+                        # batches + steps the wait dominated (operator
+                        # counter for input-starvation alerts)
+                        input_wait_seconds=input_wait_total,
+                        input_starved_steps=float(starved_steps),
                     )
+                    if compile_s is not None:
+                        report_kw["compile_seconds"] = compile_s
                     if files_iter is not None and files_iter.dataset is not None:
                         # windowed input bandwidth: an operator alert can
                         # SEE input starvation (pure-Python codec fallback
@@ -1143,12 +1209,36 @@ def run_task(
     failed pod is how the control plane learns training went wrong
     (SURVEY.md §3.5). Pass ``mesh`` when the caller already built it (e.g.
     to construct a mesh-bound attention fn); it must match the env's
-    TFK8S_MESH contract."""
+    TFK8S_MESH contract.
+
+    Continues the trace stamped into the pod env (TFK8S_TRACEPARENT):
+    ``trainer.run`` is the umbrella under which startup / first-compile /
+    first-step spans nest — on the hermetic kubelet the parent is already
+    the calling thread's ``kubelet.launch`` span, across a real process
+    boundary the env var carries the link."""
     env = dict(env or {})
-    ctx = ProcessContext.from_env(env)
-    initialize_distributed(ctx, env)
-    if mesh is None:
-        mesh = build_mesh(ctx)
+    tracer = get_tracer()
+    with tracer.start_span(
+        "trainer.run",
+        traceparent=env.get(TRACEPARENT_ENV),
+        attributes={"task": task.name},
+    ):
+        with tracer.start_span("trainer.startup", attributes={"task": task.name}):
+            ctx = ProcessContext.from_env(env)
+            initialize_distributed(ctx, env)
+            if mesh is None:
+                mesh = build_mesh(ctx)
+        return _run_task_inner(task, env, stop, config, mesh, ctx)
+
+
+def _run_task_inner(
+    task: TrainTask,
+    env: Dict[str, str],
+    stop: Optional[Any],
+    config: Optional[TrainConfig],
+    mesh: Mesh,
+    ctx: ProcessContext,
+) -> Dict[str, float]:
 
     if config is None:
         config = TrainConfig(

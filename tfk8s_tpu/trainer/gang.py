@@ -427,9 +427,9 @@ class SliceAllocator:
 
     def capacity_summary(self) -> Dict[str, int]:
         """Free whole-slice count per physical accelerator type in the
-        inventory — the operator exports these as per-accelerator gauges
-        (``gang.free_slices.<accelerator>`` on /metrics, e.g.
-        ``gang_free_slices_v5litepod_16`` after Prometheus sanitization)."""
+        inventory — the operator exports these as one labeled gauge on
+        /metrics: ``gang_free_slices{accelerator="<type>"}``, e.g.
+        ``gang_free_slices{accelerator="v5litepod-16"}``."""
         with self._lock:
             accs = sorted({ps.info.accelerator for ps, _ in self._slices.values()})
         return {acc: self.free_slices(acc) for acc in accs}

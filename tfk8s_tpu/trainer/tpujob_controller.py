@@ -48,6 +48,7 @@ from tfk8s_tpu.client.informer import SharedIndexInformer, ResourceEventHandler
 from tfk8s_tpu.client.listers import Lister
 from tfk8s_tpu.client.store import AlreadyExists, Conflict, NotFound
 from tfk8s_tpu.controller.controller import Controller
+from tfk8s_tpu.obs.trace import TRACEPARENT_ENV, Tracer, get_tracer
 from tfk8s_tpu.trainer import labels as L
 from tfk8s_tpu.trainer import replicas as R
 from tfk8s_tpu.trainer.gang import SliceAllocator
@@ -59,10 +60,26 @@ FINALIZER = "tfk8s.dev/job-cleanup"
 RESTARTS_ANNOTATION = "tfk8s.dev/restarts"
 PENDING_REQUEUE_S = 0.5
 
-# Env keys derived from the (in-memory) SliceAllocator's placement rather
-# than the job spec; excluded from the stale-render diff in
-# _reconcile_replicas so an operator restart doesn't churn running gangs.
-_PLACEMENT_ENV_KEYS = frozenset({"TFK8S_SLICE_ID", "TFK8S_HOST_INDEX"})
+# Env keys derived from per-sync controller state rather than the job
+# spec — the SliceAllocator's in-memory placement and the creating
+# sync's trace context; excluded from the stale-render diff in
+# _reconcile_replicas so an operator restart (fresh placement, fresh
+# trace ids) doesn't churn running gangs.
+_PLACEMENT_ENV_KEYS = frozenset(
+    {"TFK8S_SLICE_ID", "TFK8S_HOST_INDEX", TRACEPARENT_ENV}
+)
+
+# Training-progress keys mirrored from pod status into per-job labeled
+# gauges on /metrics (runtime/progress.py -> runtime/kubelet.py -> here).
+_TRAINING_GAUGE_KEYS = (
+    "steps_per_sec",
+    "examples_per_sec",
+    "step",
+    "compile_seconds",
+    "input_mb_per_sec",
+    "input_wait_seconds",
+    "input_starved_steps",
+)
 
 # Node-lost detection (k8s node-lease semantics): a RUNNING pod whose
 # node's heartbeat Lease (runtime/kubelet.py NODE_LEASE_PREFIX) has been
@@ -93,6 +110,7 @@ class TPUJobController:
         recorder: Optional[EventRecorder] = None,
         metrics: Optional[Metrics] = None,
         resync_period: float = 0.0,
+        tracer: Optional[Tracer] = None,
     ):
         self.cs = clientset
         self.allocator = allocator or SliceAllocator()
@@ -101,15 +119,19 @@ class TPUJobController:
         # `get --kind events` work across the apiserver
         self.recorder = recorder or EventRecorder(sink=clientset)
         self.metrics = metrics or Metrics()
+        self.tracer = tracer or get_tracer()
 
         self.job_informer = SharedIndexInformer(
-            clientset.tpujobs(namespace=None), resync_period, name="tpujob"
+            clientset.tpujobs(namespace=None), resync_period, name="tpujob",
+            metrics=self.metrics,
         )
         self.pod_informer = SharedIndexInformer(
-            clientset.pods(namespace=None), resync_period, name="pod"
+            clientset.pods(namespace=None), resync_period, name="pod",
+            metrics=self.metrics,
         )
         self.svc_informer = SharedIndexInformer(
-            clientset.services(namespace=None), resync_period, name="service"
+            clientset.services(namespace=None), resync_period, name="service",
+            metrics=self.metrics,
         )
         self.jobs = Lister(self.job_informer.indexer, "TPUJob")
         self.pods = Lister(self.pod_informer.indexer, "Pod")
@@ -122,6 +144,7 @@ class TPUJobController:
             recorder=self.recorder,
             metrics=self.metrics,
             kind="TPUJob",
+            tracer=self.tracer,
         )
         self.job_informer.add_event_handler(self.controller.default_handler())
         # Pod/Service events reconcile their owning job (the enqueuePod
@@ -141,6 +164,22 @@ class TPUJobController:
             on_update=lambda old, new: self._enqueue_owner(new),
             on_delete=self._enqueue_owner,
         ))
+        for mname, help_text in (
+            ("tpujob.pods_created_total", "Pods created by the reconciler."),
+            ("tpujob.pods_deleted_total", "Pods deleted by the reconciler."),
+            ("tpujob.gang_restarts_total", "Whole-gang restarts from checkpoint."),
+            ("tpujob.gang_pending_total", "Syncs that found no gang capacity."),
+            ("tpujob.succeeded_total", "Jobs that reached Succeeded."),
+            ("tpujob.preemptions_total", "Gangs evicted for higher priority."),
+            ("tpujob.suspensions_total", "Gangs parked by RunPolicy.suspend."),
+            ("tpujob.node_lost_pods_total", "Running pods failed via stale node lease."),
+            ("gang.free_slices", "Free whole slices per accelerator type."),
+            ("tpujob.training.steps_per_sec", "Per-job reported training step rate."),
+            ("tpujob.training.step_seconds", "Per-job distribution of step wall time."),
+            ("tpujob.training.compile_seconds", "Per-job first-step compile time."),
+            ("tpujob.training.input_starved_steps", "Per-job steps that waited on input."),
+        ):
+            self.metrics.describe(mname, help_text)
         # gang release needs the uid after the job object is gone
         self._uid_by_key: dict = {}
         # pod name -> restart count to stamp on the next recreation
@@ -175,16 +214,22 @@ class TPUJobController:
             if job:
                 owner = self.jobs.get_by_key(f"{new.metadata.namespace}/{job}")
             if owner is not None and owner.metadata.deletion_timestamp is None:
-                series = f"tpujob.training.{new.metadata.namespace}.{job}"
-                for k in ("steps_per_sec", "examples_per_sec", "step"):
+                # LABELED series (one name, per-job label set): deletion
+                # GCs exactly this job's series via remove_labels —
+                # metric names stay fixed as jobs come and go
+                job_labels = {"namespace": new.metadata.namespace, "job": job}
+                for k in _TRAINING_GAUGE_KEYS:
                     if k in new.status.training:
                         self.metrics.set_gauge(
-                            f"{series}.{k}", new.status.training[k]
+                            f"tpujob.training.{k}",
+                            new.status.training[k],
+                            job_labels,
                         )
                 if "step_seconds" in new.status.training:
                     self.metrics.observe(
-                        f"{series}.step_seconds",
+                        "tpujob.training.step_seconds",
                         new.status.training["step_seconds"],
+                        job_labels,
                     )
         if (
             old.metadata.resource_version != new.metadata.resource_version
@@ -211,7 +256,8 @@ class TPUJobController:
 
     def sync(self, key: str) -> None:
         ns, name = key.split("/", 1)
-        job = self.jobs.get_by_key(key)
+        with self.tracer.start_span("lister.get", attributes={"key": key}):
+            job = self.jobs.get_by_key(key)
         if job is None:
             # Object gone from cache: release any gang it held
             uid = self._uid_by_key.pop(key, None)
@@ -304,7 +350,7 @@ class TPUJobController:
                 f"insufficient capacity for {job.spec.tpu.accelerator} "
                 f"x{job.spec.tpu.num_slices}",
             )
-            self.metrics.inc("tpujob.gang_pending")
+            self.metrics.inc("tpujob.gang_pending_total")
             timeout = job.spec.run_policy.scheduling.admission_timeout_s
             created = helpers.get_condition(job.status, JobConditionType.CREATED)
             # The timeout bounds INITIAL admission only (never-started
@@ -397,7 +443,7 @@ class TPUJobController:
         if not self._write_status(job):
             return  # conflict: re-enqueued sync redoes the accounting
         self.recorder.event("TPUJob", key, "JobSuspended")
-        self.metrics.inc("tpujob.suspensions")
+        self.metrics.inc("tpujob.suspensions_total")
         self._delete_job_pods(job, only_phases=None)
         self.allocator.release(job.metadata.uid)
         self._export_capacity_gauges()
@@ -545,7 +591,7 @@ class TPUJobController:
         self.recorder.event(
             "TPUJob", job.metadata.key, "PreemptedOther", vkey,
         )
-        self.metrics.inc("tpujob.preemptions")
+        self.metrics.inc("tpujob.preemptions_total")
         return True
 
     def _check_node_liveness(self, job: TPUJob, observed) -> None:
@@ -588,7 +634,7 @@ class TPUJobController:
             )
             self.recorder.event("TPUJob", key, "NodeLost",
                                 f"{pod.metadata.name}: {msg}")
-            self.metrics.inc("tpujob.node_lost_pods")
+            self.metrics.inc("tpujob.node_lost_pods_total")
             try:
                 cur = self.cs.pods(ns).get(pod.metadata.name)
                 if (
@@ -628,15 +674,16 @@ class TPUJobController:
         pfloor = self._preemptions_floor.get(key, 0)
         if job.status.preemptions < pfloor:
             job.status.preemptions = pfloor
-        desired_pods, desired_svcs = R.render_all(job, ga)
-        desired_names = {p.metadata.name for p in desired_pods}
-        desired_svc_names = {s.metadata.name for s in desired_svcs}
-        observed = {p.metadata.name: p for p in self._observed_pods(job)}
+        with self.tracer.start_span("diff", attributes={"job": key}):
+            desired_pods, desired_svcs = R.render_all(job, ga)
+            desired_names = {p.metadata.name for p in desired_pods}
+            desired_svc_names = {s.metadata.name for s in desired_svcs}
+            observed = {p.metadata.name: p for p in self._observed_pods(job)}
+            observed_svcs = {
+                s.metadata.name
+                for s in self.services.list(ns, L.job_selector(job.metadata.name))
+            }
         self._check_node_liveness(job, observed)
-        observed_svcs = {
-            s.metadata.name
-            for s in self.services.list(ns, L.job_selector(job.metadata.name))
-        }
 
         # Orphans (scale-down or stale template): delete pods AND services.
         for pname, pod in observed.items():
@@ -699,11 +746,21 @@ class TPUJobController:
                 restarts = self._pending_restart_counts.pop(pod.metadata.key, None)
                 if restarts is not None:
                     pod.metadata.annotations[RESTARTS_ANNOTATION] = str(restarts)
-                try:
-                    self.cs.pods(ns).create(pod)
-                    self.metrics.inc("tpujob.pods_created")
-                except AlreadyExists:
-                    pass
+                with self.tracer.start_span(
+                    "pod.create", attributes={"pod": pod.metadata.key}
+                ) as sp:
+                    # the handoff across the control->data plane boundary:
+                    # the kubelet (and through it the trainer) continues
+                    # THIS span's trace — CRD update to step 1, one trace
+                    if sp.traceparent and pod.spec.containers:
+                        pod.spec.containers[0].env[TRACEPARENT_ENV] = (
+                            sp.traceparent
+                        )
+                    try:
+                        self.cs.pods(ns).create(pod)
+                        self.metrics.inc("tpujob.pods_created_total")
+                    except AlreadyExists:
+                        pass
 
         self._update_job_status(job, status_changed)
 
@@ -816,7 +873,7 @@ class TPUJobController:
             self.recorder.event(
                 "TPUJob", key, "GangRestart", f"#{job.status.gang_restarts}"
             )
-            self.metrics.inc("tpujob.gang_restarts")
+            self.metrics.inc("tpujob.gang_restarts_total")
             self._delete_job_pods(job, only_phases=None)
             return True
 
@@ -862,7 +919,9 @@ class TPUJobController:
             return
         self._gauges_version = v
         for acc, n in self.allocator.capacity_summary().items():
-            self.metrics.set_gauge(f"gang.free_slices.{acc}", float(n))
+            self.metrics.set_gauge(
+                "gang.free_slices", float(n), {"accelerator": acc}
+            )
 
     def _record_evaluator_failure(self, key: str, pod: Pod) -> None:
         """Once-per-pod-uid event: the terminally-Failed evaluator pod is
@@ -889,7 +948,7 @@ class TPUJobController:
     def _delete_pod(self, ns: str, name: str) -> None:
         try:
             self.cs.pods(ns).delete(name)
-            self.metrics.inc("tpujob.pods_deleted")
+            self.metrics.inc("tpujob.pods_deleted_total")
         except NotFound:
             pass
 
@@ -954,7 +1013,7 @@ class TPUJobController:
             ):
                 job.status.completion_time = time.time()
                 self.recorder.event("TPUJob", key, "JobSucceeded")
-                self.metrics.inc("tpujob.succeeded")
+                self.metrics.inc("tpujob.succeeded_total")
                 changed = True
             self.allocator.release(job.metadata.uid)
             self._export_capacity_gauges()
@@ -999,13 +1058,16 @@ class TPUJobController:
         if isinstance(rs, dict):
             for rt in ReplicaType:
                 rs.setdefault(rt.value, None)
-        try:
-            self.cs.tpujobs(job.metadata.namespace).patch_status(
-                job.metadata.name, {"status": wire_status}
-            )
-            return True
-        except NotFound:
-            return False
+        with self.tracer.start_span(
+            "status.update", attributes={"job": job.metadata.key}
+        ):
+            try:
+                self.cs.tpujobs(job.metadata.namespace).patch_status(
+                    job.metadata.name, {"status": wire_status}
+                )
+                return True
+            except NotFound:
+                return False
 
     # ------------------------------------------------------ teardown paths
 
@@ -1094,7 +1156,8 @@ class TPUJobController:
         # a deleted job leaves no Event objects behind
         self.recorder.flush()
         self._delete_job_events(job)
-        # ... and no /metrics series either (same leave-nothing contract)
-        self.metrics.remove_prefix(
-            f"tpujob.training.{job.metadata.namespace}.{job.metadata.name}."
+        # ... and no /metrics series either (same leave-nothing contract):
+        # label-based GC removes exactly this job's labeled series
+        self.metrics.remove_labels(
+            {"namespace": job.metadata.namespace, "job": job.metadata.name}
         )

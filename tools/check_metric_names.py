@@ -1,0 +1,114 @@
+"""Metric-name lint: keep the /metrics namespace coherent as it grows.
+
+Statically scans Python sources for registry calls (``metrics.inc(...)``,
+``.observe(...)``, ``.set_gauge(...)``, ``.describe(...)``) and validates
+every literal metric name against the conventions the build exposes on
+/metrics (Prometheus naming + unit-suffix rules):
+
+- the EXPOSED name (dots/dashes sanitize to underscores, see
+  utils/logging.py) must be snake_case: ``[a-z_][a-z0-9_]*``; no
+  uppercase, no digits-first, nothing that needs further mangling;
+- counters (``inc``) must end in ``_total`` — the Prometheus counter
+  convention that makes rate() targets self-describing;
+- histograms (``observe``) must carry a unit suffix: ``_seconds`` or
+  ``_bytes``;
+- f-string name segments are allowed for registry prefixes (e.g.
+  ``f"{self.name}.syncs_total"``); each ``{...}`` placeholder is treated
+  as an opaque snake_case atom, so the surrounding literal text still
+  lints. Dynamic identity belongs in LABELS, not in the name — which is
+  why a placeholder in the FINAL name segment of a counter/histogram
+  still has to satisfy the suffix rule through the literal tail.
+
+Wired into the tier-1 suite by tests/test_metric_names.py; also runnable
+standalone: ``python tools/check_metric_names.py [paths...]`` exits 1 and
+prints one line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+# metrics.inc("name"...) / self.metrics.observe(f"...")/ m.set_gauge('x')
+_CALL_RE = re.compile(
+    r"""\.(?P<verb>inc|observe|set_gauge|describe)\(\s*
+        (?P<fprefix>f?)(?P<quote>['"])(?P<name>[^'"]+)(?P=quote)""",
+    re.VERBOSE,
+)
+_PLACEHOLDER_RE = re.compile(r"\{[^}]*\}")
+_EXPOSED_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+_HIST_SUFFIXES = ("_seconds", "_bytes")
+
+
+def _sanitize(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+def lint_source(path: str, source: str) -> List[str]:
+    problems: List[str] = []
+    for m in _CALL_RE.finditer(source):
+        verb, raw = m.group("verb"), m.group("name")
+        line = source.count("\n", 0, m.start()) + 1
+        where = f"{path}:{line}"
+        name = raw
+        if m.group("fprefix"):
+            # each interpolated segment is an opaque snake_case atom
+            name = _PLACEHOLDER_RE.sub("x", name)
+        exposed = _sanitize(name)
+        if not _EXPOSED_NAME_RE.match(exposed):
+            problems.append(
+                f"{where}: {verb}({raw!r}) exposes {exposed!r} — not snake_case"
+            )
+            continue
+        if verb == "inc" and not exposed.endswith("_total"):
+            problems.append(
+                f"{where}: counter {raw!r} must end in _total"
+            )
+        if verb == "observe" and not exposed.endswith(_HIST_SUFFIXES):
+            problems.append(
+                f"{where}: histogram {raw!r} must end in one of "
+                f"{'/'.join(_HIST_SUFFIXES)}"
+            )
+    return problems
+
+
+def lint_paths(paths: List[str]) -> List[str]:
+    problems: List[str] = []
+    for root in paths:
+        if os.path.isfile(root):
+            files: List[Tuple[str, str]] = [(root, open(root).read())]
+        else:
+            files = []
+            for dirpath, _dirs, names in os.walk(root):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        p = os.path.join(dirpath, n)
+                        files.append((p, open(p).read()))
+        for path, src in files:
+            if os.path.basename(path) == os.path.basename(__file__):
+                continue  # the linter's own docstring examples
+            problems.extend(lint_source(path, src))
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = argv or [
+        os.path.join(here, "tfk8s_tpu"),
+        os.path.join(here, "tools"),
+    ]
+    problems = lint_paths(paths)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} metric-name problem(s)")
+        return 1
+    print("metric names ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
