@@ -456,6 +456,64 @@ def _recordio_probe(small: bool):
         shutil.rmtree(d, ignore_errors=True)
 
 
+# images/s the ResNet-50 headline consumes at the measured step rate —
+# the decode pool must deliver at least this or input starves the chip
+# (ISSUE 2 budget: >=2,447 img/s, ~370 MB/s decoded float32 at 224px)
+_IMAGE_BUDGET_IMG_S = 2447
+
+
+def _image_pipeline_probe(small: bool):
+    """Image data-plane throughput on THIS host: pack a synthetic JPEG
+    shard set (data/images/pack.py), then run the decode+augment worker
+    pool (ImageDataset) over one epoch and report delivered images/s and
+    decoded MB/s against the input budget. Host-side only. Returns None
+    when no image decoder is importable."""
+    import shutil
+    import tempfile
+
+    from tfk8s_tpu.data.images import ImageDataset, pack
+    from tfk8s_tpu.data.images.decode import have_decoder
+
+    if not have_decoder():
+        return None
+    # small: tiny images for rc coverage; full: the headline 224px shape
+    n, size, classes, bs = (96, 64, 8, 32) if small else (1024, 224, 16, 64)
+    d = tempfile.mkdtemp(prefix="bench-images-")
+    try:
+        paths = pack.pack_synthetic(d, n, classes, size, 2, seed=0)
+        shard_mb = sum(os.path.getsize(p) for p in paths) / 1e6
+        ds = ImageDataset(
+            paths, batch_size=bs, image_size=size, train=True, seed=0
+        )
+        next(iter(ds.batches(0)))  # warm: pool spin-up + page cache
+        decoded0, bytes0 = ds.images_decoded, ds.decoded_bytes
+        t0 = time.perf_counter()
+        for _ in ds.batches(0):
+            pass
+        dt = time.perf_counter() - t0
+        imgs = ds.images_decoded - decoded0
+        dec_mb = (ds.decoded_bytes - bytes0) / 1e6
+        ds.close()
+        img_s = imgs / dt
+        return {
+            "image_decode_images_per_sec": round(img_s, 1),
+            "image_decode_mbps_decoded": round(dec_mb / dt, 1),
+            "image_decode_workers": ds.workers,
+            "image_px": size,
+            "image_shard_mb": round(shard_mb, 1),
+            "image_budget_images_per_sec": _IMAGE_BUDGET_IMG_S,
+            # the budget describes the FULL 224px shape; small mode's
+            # tiny images would claim a meaningless pass
+            **(
+                {"image_meets_budget": bool(img_s >= _IMAGE_BUDGET_IMG_S)}
+                if not small
+                else {}
+            ),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _PROBE_CODE = """
 import os
 if os.environ.get("BENCH_PLATFORM"):
@@ -714,6 +772,16 @@ def main() -> None:
             print(f"bench: recordio probe failed: {exc}", file=sys.stderr)
             degraded.append("recordio")
 
+    # -- image data plane: decode+augment pool images/s vs the input
+    # budget the ResNet-50 headline implies (host-side, no chip) --------
+    image_block = None
+    if os.environ.get("BENCH_IMAGES", "1") == "1":
+        try:
+            image_block = _image_pipeline_probe(small)
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: image pipeline probe failed: {exc}", file=sys.stderr)
+            degraded.append("images")
+
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     baseline_note = {}
@@ -784,9 +852,7 @@ def main() -> None:
             "bert_mfu": round(bert_mfu, 4),
         }
 
-    print(
-        json.dumps(
-            {
+    detail = {
                 "metric": "resnet50_images_per_sec_per_chip",
                 "value": round(value, 2),
                 "unit": "images/sec/chip",
@@ -910,6 +976,7 @@ def main() -> None:
                         else {}
                     ),
                     **({"recordio": recordio_block} if recordio_block else {}),
+                    **({"images": image_block} if image_block else {}),
                     **(
                         {
                             "flash_attn_ms_seq2048": round(flash_ms, 3),
@@ -953,8 +1020,81 @@ def main() -> None:
                     ),
                 },
             }
+
+    # -- driver artifact contract (VERDICT r5 next #1): the FINAL stdout
+    # line is one compact headline JSON that fits the driver's tail
+    # capture; the full measurement record goes to a committed
+    # BENCH_DETAIL_*.json the headline names. Round 5 broke here — the
+    # detail outgrew the 2,000-char tail and the archived artifact lost
+    # its headline keys entirely.
+    tag = os.environ.get("BENCH_TAG", "local")
+    detail_name = f"BENCH_DETAIL_{tag}.json"
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), detail_name)
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(detail, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:  # read-only checkout: headline still stands
+        print(f"bench: could not write {detail_name}: {exc}", file=sys.stderr)
+        detail_name = None
+
+    extra = detail["extra"]
+    headline_extra = {
+        k: extra[k]
+        for k in (
+            "bert_base_mlm_step_time_ms",
+            "resnet_mfu",
+            "bert_mfu",
+            "resnet_batch_size",
+            "bert_batch_size",
+            "bert_seq_len",
+            "n_chips",
+            "gpt2_decode_tokens_per_sec",
+            "flash_attn_speedup",
+            "degraded_sections",
+            "baseline_config_mismatch",
         )
-    )
+        if k in extra
+    }
+    if image_block:
+        # the new decode row rides the headline (acceptance criterion):
+        # delivered img/s + decoded MB/s vs the ResNet input budget
+        headline_extra.update(
+            {
+                k: image_block[k]
+                for k in (
+                    "image_decode_images_per_sec",
+                    "image_decode_mbps_decoded",
+                    "image_decode_workers",
+                    "image_px",
+                    "image_budget_images_per_sec",
+                    "image_meets_budget",
+                )
+                if k in image_block
+            }
+        )
+    headline = {
+        "metric": detail["metric"],
+        "value": detail["value"],
+        "unit": detail["unit"],
+        "vs_baseline": detail["vs_baseline"],
+        **({"detail": detail_name} if detail_name else {}),
+        "extra": headline_extra,
+    }
+    line = json.dumps(headline)
+    # hard ceiling with a graceful degrade order — never exceed the
+    # contract even if a future key grows
+    _HEADLINE_MAX = 1800
+    for drop in (
+        "flash_attn_speedup", "gpt2_decode_tokens_per_sec", "bert_seq_len",
+        "bert_batch_size", "image_px", "image_decode_workers", "bert_mfu",
+        "resnet_mfu",
+    ):
+        if len(line) <= _HEADLINE_MAX:
+            break
+        headline["extra"].pop(drop, None)
+        line = json.dumps(headline)
+    print(line)
 
 
 if __name__ == "__main__":

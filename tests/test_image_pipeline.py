@@ -1,0 +1,339 @@
+"""Image data plane (ISSUE 2 tentpole): schema round-trip, golden
+decode, seed-deterministic augmentation across resume, the worker-pool
+throughput layer (no leaked threads, metrics exported), and the packer
+CLI. The files-backed ResNet e2e lives in tests/test_image_job_e2e.py.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tfk8s_tpu.data.images import (
+    ImageDataset,
+    ImageDecodeError,
+    ImageSchemaError,
+    decode_image,
+    decode_image_example,
+    encode_image_example,
+    encode_jpeg,
+    encode_png,
+    eval_transform,
+    set_metrics,
+    train_transform,
+    write_image_shards,
+)
+from tfk8s_tpu.data.images import pack, schema
+from tfk8s_tpu.data.images.transforms import sample_crop
+from tfk8s_tpu.utils.logging import Metrics
+
+
+def _checker(h=24, w=32, seed=7):
+    """A deterministic RGB test card: per-pixel ramps + a checkerboard,
+    so crops/flips are position-sensitive."""
+    rng = np.random.default_rng(seed)
+    y, x = np.mgrid[0:h, 0:w]
+    arr = np.stack(
+        [
+            (x * 255 // max(w - 1, 1)),
+            (y * 255 // max(h - 1, 1)),
+            ((x + y) % 2) * 255,
+        ],
+        axis=-1,
+    ).astype(np.uint8)
+    arr ^= rng.integers(0, 8, size=arr.shape, dtype=np.uint8)
+    return arr
+
+
+class TestSchema:
+    def test_roundtrip_jpeg(self):
+        raw = encode_jpeg(_checker(), quality=95)
+        rec = encode_image_example(raw, label=3, shape=(24, 32, 3))
+        ex = decode_image_example(rec)
+        assert ex.encoded == raw
+        assert (ex.label, ex.format) == (3, "jpeg")
+        assert (ex.height, ex.width, ex.channels) == (24, 32, 3)
+
+    def test_format_sniffed_from_magic(self):
+        assert schema.sniff_format(encode_png(_checker())) == "png"
+        assert schema.sniff_format(encode_jpeg(_checker())) == "jpeg"
+        ex = decode_image_example(encode_image_example(encode_png(_checker()), 0))
+        assert ex.format == "png"
+
+    def test_garbage_bytes_rejected_at_pack_time(self):
+        with pytest.raises(ImageSchemaError, match="container"):
+            encode_image_example(b"not an image at all", label=0)
+
+    def test_wrong_schema_record_named(self):
+        from tfk8s_tpu.data import example as codec
+
+        rec = codec.encode({"input": np.arange(8, dtype=np.int32)})
+        with pytest.raises(ImageSchemaError, match="corpus"):
+            decode_image_example(rec)
+
+    def test_shard_writer_atomic(self, tmp_path):
+        def records():
+            yield encode_image_example(encode_png(_checker()), 0)
+            raise RuntimeError("packing died mid-stream")
+
+        with pytest.raises(RuntimeError):
+            write_image_shards(records(), str(tmp_path), 1)
+        assert list(tmp_path.iterdir()) == []  # no partial shards left
+
+    def test_shard_writer_rejects_underfilled_shards(self, tmp_path):
+        recs = [encode_image_example(encode_png(_checker()), 0)]
+        with pytest.raises(ValueError, match="at least one record"):
+            write_image_shards(iter(recs), str(tmp_path), 4)
+
+
+class TestDecode:
+    def test_golden_png_pins_exact_pixels(self):
+        """PNG is lossless: encode -> decode must reproduce the array
+        bit-for-bit (the pinned-pixel golden the augmentations build on)."""
+        src = _checker()
+        out = decode_image(encode_png(src))
+        assert out.dtype == np.uint8 and out.shape == (24, 32, 3)
+        np.testing.assert_array_equal(out, src)
+
+    def test_jpeg_decodes_close_to_source(self):
+        # smooth gradients (no checkerboard): JPEG is lossy but bounded
+        # on low-frequency content
+        y, x = np.mgrid[0:24, 0:32]
+        src = np.stack(
+            [x * 8, y * 10, (x + y) * 4], axis=-1
+        ).astype(np.uint8)
+        out = decode_image(encode_jpeg(src, quality=95))
+        assert out.shape == (24, 32, 3)
+        assert float(np.mean(np.abs(out.astype(int) - src.astype(int)))) < 8
+
+    def test_undecodable_bytes_raise_typed_error(self):
+        with pytest.raises(ImageDecodeError):
+            decode_image(b"\xff\xd8\xffgarbage-after-jpeg-magic")
+
+
+class TestTransforms:
+    def test_train_transform_seed_deterministic(self):
+        src = _checker(64, 48)
+        a = train_transform(src, np.random.default_rng(5), 32)
+        b = train_transform(src, np.random.default_rng(5), 32)
+        np.testing.assert_array_equal(a, b)
+        c = train_transform(src, np.random.default_rng(6), 32)
+        assert not np.array_equal(a, c)
+        assert a.shape == (32, 32, 3) and a.dtype == np.float32
+
+    def test_eval_transform_deterministic_and_centered(self):
+        src = _checker(100, 80)
+        a = eval_transform(src, 32)
+        np.testing.assert_array_equal(a, eval_transform(src, 32))
+        assert a.shape == (32, 32, 3) and a.dtype == np.float32
+
+    def test_sample_crop_within_bounds(self):
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            top, left, h, w = sample_crop(rng, 37, 53)
+            assert 0 <= top and top + h <= 37
+            assert 0 <= left and left + w <= 53
+            assert h > 0 and w > 0
+
+    def test_normalize_statistics(self):
+        from tfk8s_tpu.data.images.transforms import normalize
+
+        flat = np.full((4, 4, 3), 128, np.uint8)
+        out = normalize(flat)
+        # (128/255 - mean) / std, per channel
+        want = (128 / 255 - np.array([0.485, 0.456, 0.406])) / np.array(
+            [0.229, 0.224, 0.225]
+        )
+        np.testing.assert_allclose(out[0, 0], want.astype(np.float32), rtol=1e-5)
+
+
+@pytest.fixture()
+def shards(tmp_path):
+    return pack.pack_synthetic(
+        str(tmp_path / "sh"), 48, classes=4, image_size=40, num_shards=2,
+        seed=9,
+    )
+
+
+class TestImageDataset:
+    def test_batches_match_vision_schema(self, shards):
+        ds = ImageDataset(shards, batch_size=8, image_size=32, seed=1)
+        try:
+            b = next(iter(ds.batches(0)))
+            assert b["image"].shape == (8, 32, 32, 3)
+            assert b["image"].dtype == np.float32
+            assert b["label"].shape == (8,) and b["label"].dtype == np.int32
+            assert set(int(x) for x in b["label"]) <= set(range(4))
+        finally:
+            ds.close()
+
+    def test_augmentation_deterministic_across_instances(self, shards):
+        a = ImageDataset(shards, batch_size=8, image_size=32, seed=3)
+        b = ImageDataset(shards, batch_size=8, image_size=32, seed=3)
+        try:
+            for ba, bb, _ in zip(a.batches(0), b.batches(0), range(3)):
+                np.testing.assert_array_equal(ba["image"], bb["image"])
+                np.testing.assert_array_equal(ba["label"], bb["label"])
+        finally:
+            a.close()
+            b.close()
+
+    def test_epochs_reaugment(self, shards):
+        """Same records, new epoch -> different crops/flips (the seed
+        folds the epoch), while re-running the SAME epoch reproduces it."""
+        ds = ImageDataset(shards, batch_size=48, image_size=32, seed=3,
+                          shuffle=False)
+        try:
+            e0 = next(iter(ds.batches(0)))["image"]
+            e0_again = next(iter(ds.batches(0)))["image"]
+            e1 = next(iter(ds.batches(1)))["image"]
+            np.testing.assert_array_equal(e0, e0_again)
+            assert not np.array_equal(e0, e1)
+        finally:
+            ds.close()
+
+    def test_resume_replays_identical_stream(self, shards):
+        """iterator(start_batch=k) must equal batch k of an uninterrupted
+        run — augmentation AND shuffle both replay (checkpoint-resume
+        determinism, the tentpole's resume requirement)."""
+        ds = ImageDataset(shards, batch_size=8, image_size=32, seed=11)
+        it = ds.iterator(prefetch=0)
+        want = [next(it) for _ in range(5)]
+        res = ImageDataset(shards, batch_size=8, image_size=32, seed=11)
+        rit = res.iterator(prefetch=0, start_batch=3)
+        try:
+            for k in (3, 4):
+                got = next(rit)
+                np.testing.assert_array_equal(want[k]["image"], got["image"])
+                np.testing.assert_array_equal(want[k]["label"], got["label"])
+        finally:
+            it.close()
+            rit.close()
+            ds.close()
+            res.close()
+
+    def test_eval_mode_unshuffled_and_stable(self, shards):
+        ds = ImageDataset(shards, batch_size=8, image_size=32, train=False)
+        try:
+            assert ds.shuffle is False
+            a = next(iter(ds.batches(0)))["image"]
+            b = next(iter(ds.batches(0)))["image"]
+            np.testing.assert_array_equal(a, b)
+        finally:
+            ds.close()
+
+    def test_pool_shutdown_leaks_no_threads(self, shards):
+        ds = ImageDataset(shards, batch_size=16, image_size=32, seed=0,
+                          workers=4)
+        next(iter(ds.batches(0)))  # spin the pool up
+        assert any(
+            t.name.startswith("img-decode") for t in threading.enumerate()
+        )
+        ds.close()
+        assert not any(
+            t.name.startswith("img-decode") for t in threading.enumerate()
+        ), [t.name for t in threading.enumerate()]
+
+    def test_metrics_exported_through_obs_registry(self, shards):
+        reg = Metrics()
+        set_metrics(reg)
+        try:
+            ds = ImageDataset(shards, batch_size=8, image_size=32, seed=0)
+            it = ds.iterator(prefetch=2)
+            for _ in range(3):
+                next(it)
+            it.close()
+            ds.close()
+            snap = reg.snapshot()
+            decoded = reg.get_counter(
+                "tfk8s_images_decoded_total", {"mode": "train"}
+            )
+            assert decoded is not None and decoded >= 24, snap["counters"]
+            assert any(
+                k.startswith("tfk8s_image_decode_seconds")
+                for k in snap["histograms"]
+            ), snap["histograms"]
+            assert "tfk8s_image_decode_queue_depth" in snap["gauges"]
+            text = reg.prometheus_text()
+            assert "tfk8s_images_decoded_total" in text
+        finally:
+            set_metrics(None)
+
+    def test_corpus_shard_fails_with_schema_message(self, tmp_path):
+        from tfk8s_tpu.data import RecordWriter
+        from tfk8s_tpu.data import example as codec
+
+        p = str(tmp_path / "text.rio")
+        with RecordWriter(p) as w:
+            for _ in range(4):
+                w.write(codec.encode({"input": np.arange(8, dtype=np.int32)}))
+        ds = ImageDataset([p], batch_size=2, image_size=32)
+        try:
+            with pytest.raises(ImageDecodeError, match="corpus"):
+                next(iter(ds.batches(0)))
+        finally:
+            ds.close()
+
+
+class TestPackCLI:
+    def test_synthetic_pack_writes_shards_and_labels(self, tmp_path):
+        out = tmp_path / "packed"
+        pack.main([
+            "--synthetic", "24", "--classes", "3", "--image-size", "32",
+            "--out-dir", str(out), "--num-shards", "2", "--seed", "5",
+        ])
+        shards = sorted(os.listdir(out))
+        assert shards == ["images-00000.rio", "images-00001.rio", "labels.json"]
+        labels = json.loads((out / "labels.json").read_text())
+        assert labels == {"class000": 0, "class001": 1, "class002": 2}
+        ds = ImageDataset(
+            [str(out / s) for s in shards if s.endswith(".rio")],
+            batch_size=8, image_size=32,
+        )
+        try:
+            assert len(ds) == 24
+            next(iter(ds.batches(0)))
+        finally:
+            ds.close()
+
+    def test_tree_pack_imagenet_layout(self, tmp_path):
+        root = tmp_path / "tree"
+        for ci, cls in enumerate(["ant", "bee"]):
+            d = root / cls
+            d.mkdir(parents=True)
+            for i in range(3):
+                (d / f"im{i}.jpg").write_bytes(
+                    encode_jpeg(_checker(seed=ci * 10 + i))
+                )
+            # non-image clutter must be skipped, not packed
+            (d / "notes.txt").write_text("skip me")
+        paths, n = pack.pack_tree(str(root), str(tmp_path / "out"), 2)
+        assert n == 6
+        labels = json.loads((tmp_path / "out" / "labels.json").read_text())
+        assert labels == {"ant": 0, "bee": 1}
+        got = sorted(
+            decode_image_example(r).label
+            for p in paths
+            for r in __import__(
+                "tfk8s_tpu.data.recordio", fromlist=["RecordFile"]
+            ).RecordFile(p)
+        )
+        assert got == [0, 0, 0, 1, 1, 1]
+
+
+class TestTrainerGeometry:
+    def test_non_vision_task_rejected_loudly(self):
+        from tfk8s_tpu.runtime.train import _image_geometry
+
+        with pytest.raises(ValueError, match="image"):
+            _image_geometry({"input": np.zeros((1, 16), np.int32)})
+
+    def test_vision_task_size_read_off_batch(self):
+        from tfk8s_tpu.runtime.train import _image_geometry
+
+        assert _image_geometry(
+            {"image": np.zeros((1, 40, 40, 3), np.float32),
+             "label": np.zeros((1,), np.int32)}
+        ) == 40

@@ -394,8 +394,12 @@ def test_vit_converges_and_shares_the_stack():
 
     mesh = make_mesh(data=4, tensor=2)
     task = vit.task_for_mesh(mesh, batch_size=32)
+    # 180 steps: the synthetic templates moved to lazily-generated
+    # per-class streams (resnet._template — the image-input schema probe
+    # must not allocate a full bank), and the new draw of this tiny
+    # 8-class task needs a few more steps to clear the same 0.9 bar
     trainer = Trainer(
-        task, TrainConfig(steps=120, learning_rate=1e-3, log_every=40), mesh
+        task, TrainConfig(steps=180, learning_rate=1e-3, log_every=60), mesh
     )
     state, hist = trainer.fit()
     assert hist[-1]["accuracy"] > 0.9, hist[-1]

@@ -339,6 +339,43 @@ class TestHTTPPatch:
         for res in doc["resources"]:
             assert "patch" in res["verbs"], res["name"]
 
+    @pytest.mark.parametrize("body", [[1, 2], "str-patch", 7])
+    def test_non_object_patch_body_400(self, api, body):
+        """RFC 7386: a merge patch document is a JSON object — an
+        array/string/null body is a client error (400), never a 500 out
+        of store internals (ADVICE r5)."""
+        self._create(api)
+        code, err = _http("PATCH", f"{self._base(api)}/wire", body)
+        assert code == 400, (code, err)
+        assert err["reason"] == "BadRequest"
+        # the object is untouched
+        code, got = _http("GET", f"{self._base(api)}/wire")
+        assert got["spec"]["replicaSpecs"]["Worker"]["replicas"] == 2
+
+    def test_non_object_metadata_subtree_422(self, api):
+        """A dict root with a non-object metadata SUBTREE must be a 422
+        on the request content, not a 500 out of store internals."""
+        self._create(api)
+        for md in ("oops", [1, 2]):
+            code, err = _http(
+                "PATCH", f"{self._base(api)}/wire", {"metadata": md}
+            )
+            assert code == 422, (md, code, err)
+            assert err["reason"] == "Invalid"
+
+    def test_malformed_rv_precondition_422(self, api):
+        self._create(api)
+        code, err = _http(
+            "PATCH", f"{self._base(api)}/wire",
+            {"metadata": {"resourceVersion": "not-a-number"},
+             "spec": {"runPolicy": {"suspend": True}}},
+        )
+        assert code == 422, (code, err)
+        assert err["reason"] == "Invalid"
+        assert "resourceVersion" in err["message"]
+        code, got = _http("GET", f"{self._base(api)}/wire")
+        assert got["spec"]["runPolicy"]["suspend"] is False
+
 
 class TestControllerUsesPatches:
     """The VERDICT acceptance: a happy-path controller run issues ZERO

@@ -64,6 +64,70 @@ class TestBPETokenizer:
         assert a.merges == b.merges
         assert a.vocab == b.vocab
 
+    def test_specials_encode_atomically(self):
+        """A special token APPEARING in input text maps to its reserved
+        id (HF added-token behavior) instead of being BPE-split — and
+        the whole stream still round-trips (ADVICE r5)."""
+        tok = train_bpe(TEXTS, vocab_size=400, specials=["<|pad|>", "<|endoftext|>"])
+        text = "quick fox<|endoftext|>lazy dog<|pad|>"
+        ids = tok.encode(text)
+        eos, pad = tok.vocab["<|endoftext|>"], tok.vocab["<|pad|>"]
+        assert ids.count(eos) == 1 and ids.count(pad) == 1
+        assert tok.decode(ids) == text
+
+    def test_specials_survive_save_load(self, tmp_path):
+        tok = train_bpe(TEXTS, vocab_size=400, specials=["<|endoftext|>"])
+        tok.save(str(tmp_path))
+        tok2 = BPETokenizer.load(str(tmp_path))
+        assert tok2.specials == ["<|endoftext|>"]
+        probe = "a<|endoftext|>b"
+        assert tok2.encode(probe) == tok.encode(probe)
+
+    def test_arbitrary_shaped_specials_survive_save_load(self, tmp_path):
+        """Specials that do NOT look like <|...|> (e.g. BERT-style
+        [PAD]) must keep their atomic encoding through a save/load round
+        trip — persisted via special_tokens.json, not shape-guessed."""
+        tok = train_bpe(TEXTS, vocab_size=400, specials=["[PAD]", "[SEP]"])
+        tok.save(str(tmp_path))
+        assert (tmp_path / "special_tokens.json").exists()
+        tok2 = BPETokenizer.load(str(tmp_path))
+        assert tok2.specials == ["[PAD]", "[SEP]"]
+        probe = "quick[SEP]fox[PAD]"
+        assert tok2.encode(probe) == tok.encode(probe)
+        assert tok2.encode(probe).count(tok2.vocab["[SEP]"]) == 1
+
+    def test_empty_specials_manifest_blocks_phantom_specials(self, tmp_path):
+        """A tokenizer saved WITHOUT specials writes an explicit empty
+        manifest, so load() never shape-guesses a vocab piece that
+        merely LOOKS like <|...|> into a special (which would change the
+        reloaded id stream)."""
+        import json as jsonlib
+
+        tok = train_bpe(TEXTS, vocab_size=400)
+        tok.save(str(tmp_path))
+        assert jsonlib.loads(
+            (tmp_path / "special_tokens.json").read_text()
+        ) == []
+        tok2 = BPETokenizer.load(str(tmp_path))
+        assert tok2.specials == []
+        probe = "the <|endoftext|> literal is just text here"
+        assert tok2.encode(probe) == tok.encode(probe)
+
+    def test_vocab_merges_mismatch_names_the_piece(self):
+        """A merge-produced piece missing from vocab (mismatched
+        vocab.json/merges.txt pair) raises an error naming the piece and
+        the likely cause, not a bare KeyError (ADVICE r5)."""
+        from tfk8s_tpu.data.tokenizer import VocabMismatchError
+
+        tok = train_bpe(TEXTS, vocab_size=400)
+        crippled = {k: v for k, v in tok.vocab.items() if len(k) < 3}
+        bad = BPETokenizer(crippled, tok.merges)
+        with pytest.raises(VocabMismatchError, match="merges"):
+            bad.encode("the quick brown fox")
+        # still a KeyError subclass: pre-existing handlers keep working
+        with pytest.raises(KeyError):
+            bad.encode("the quick brown fox")
+
 
 class TestCorpusPacking:
     def test_cli_packs_shards(self, tmp_path):

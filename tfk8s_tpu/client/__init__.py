@@ -15,6 +15,7 @@ from tfk8s_tpu.client.store import (  # noqa: F401
     Conflict,
     EventType,
     Gone,
+    Invalid,
     NotFound,
     Watch,
     WatchEvent,

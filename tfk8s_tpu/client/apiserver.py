@@ -67,6 +67,7 @@ from tfk8s_tpu.client.store import (
     ClusterStore,
     Conflict,
     Gone,
+    Invalid,
     NotFound,
 )
 from tfk8s_tpu.utils.logging import get_logger
@@ -251,7 +252,7 @@ class _Handler(BaseHTTPRequestHandler):
             status, reason = 409, "Conflict"
         elif isinstance(exc, Gone):
             status, reason = 410, "Gone"
-        elif isinstance(exc, _AdmissionRejected):
+        elif isinstance(exc, (Invalid, _AdmissionRejected)):
             status, reason = 422, "Invalid"
         else:
             status, reason = 500, "InternalError"
@@ -477,6 +478,26 @@ class _Handler(BaseHTTPRequestHandler):
         kind, ns, name, is_status, _q = route
         try:
             patch = self._read_body()
+        except ValueError as exc:
+            self._send_json(
+                400,
+                {"reason": "BadRequest", "message": f"body is not JSON: {exc}"},
+            )
+            return
+        if not isinstance(patch, dict):
+            # RFC 7386: a merge patch document is a JSON OBJECT; an
+            # array/string/null body would otherwise reach store.patch
+            # and surface as a 500 AttributeError (ADVICE r5)
+            self._send_json(
+                400,
+                {
+                    "reason": "BadRequest",
+                    "message": "merge patch must be a JSON object, got "
+                               f"{type(patch).__name__}",
+                },
+            )
+            return
+        try:
             # admission runs on the MERGED object inside the store's
             # critical section — a patch cannot sneak an invalid spec
             # past validation, and a rejected patch commits nothing

@@ -57,6 +57,7 @@ from tfk8s_tpu.client.store import (
     EventType,
     Forbidden,
     Gone,
+    Invalid,
     NotFound,
     StoreError,
     Unauthorized,
@@ -90,6 +91,10 @@ def _map_error(status: int, reason: str, message: str) -> StoreError:
         return Conflict(message)
     if status == 410:
         return Gone(message)
+    if status == 422:
+        # typed (callers can catch Invalid) but message-compatible with
+        # the generic branch this status used to fall through to
+        return Invalid(f"HTTP {status} {reason}: {message}")
     if status >= 500:
         # server-side failure: transient by contract, retryable
         return Unavailable(f"HTTP {status} {reason}: {message}")

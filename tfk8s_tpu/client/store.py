@@ -68,6 +68,14 @@ class Gone(StoreError):
     the client must relist (HTTP 410 semantics)."""
 
 
+class Invalid(StoreError):
+    """A syntactically well-formed request whose CONTENT cannot be
+    processed (HTTP 422 semantics): e.g. a merge-patch carrying a
+    non-numeric ``metadata.resourceVersion`` precondition. Distinct from
+    admission rejection (which validates the merged OBJECT); this
+    rejects the request itself."""
+
+
 class Unavailable(StoreError):
     """The apiserver cannot be reached (connection refused/reset, 5xx) —
     transient by nature; callers with durable obligations (the kubelet's
@@ -572,12 +580,31 @@ class ClusterStore:
                 raise NotFound(f"{kind} {k} not found")
             current = bucket[k]
             patch = copy.deepcopy(patch)
-            pre_rv = (patch.get("metadata") or {}).pop("resourceVersion", None)
-            if pre_rv is not None and int(pre_rv) != current.metadata.resource_version:
-                raise Conflict(
-                    f"{kind} {k}: resourceVersion precondition {pre_rv} != "
-                    f"{current.metadata.resource_version}"
+            md = patch.get("metadata")
+            if md is not None and not isinstance(md, dict):
+                # the apiserver rejects non-object ROOTS with 400; a
+                # non-object metadata SUBTREE would otherwise crash the
+                # .pop below as a 500 — same request-content class: 422
+                raise Invalid(
+                    f"{kind} {k}: patch metadata must be an object, got "
+                    f"{type(md).__name__}"
                 )
+            pre_rv = (md or {}).pop("resourceVersion", None)
+            if pre_rv is not None:
+                try:
+                    pre_rv = int(pre_rv)
+                except (TypeError, ValueError):
+                    # malformed precondition is a 422 on the request, not
+                    # a 500 out of int() (ADVICE r5)
+                    raise Invalid(
+                        f"{kind} {k}: metadata.resourceVersion precondition "
+                        f"must be numeric, got {pre_rv!r}"
+                    ) from None
+                if pre_rv != current.metadata.resource_version:
+                    raise Conflict(
+                        f"{kind} {k}: resourceVersion precondition {pre_rv} "
+                        f"!= {current.metadata.resource_version}"
+                    )
             if subresource == "status":
                 # fast path: merge ONLY the status subtree — the
                 # controller's per-reconcile write rides this, and a

@@ -64,6 +64,14 @@ class Server:
         self.allocator = SliceAllocator(opts.capacity or None)
         self.recorder = EventRecorder(sink=self.clientset)
         self.metrics = Metrics()
+        # image-input decode metrics (tfk8s_images_decoded_total /
+        # decode-seconds / queue-depth) land on this registry: in the
+        # single-process deployment (operator + local kubelet + trainer
+        # threads, the hermetic `tfk8s run` path) the data plane's
+        # counters surface on the SAME /metrics the controller serves
+        from tfk8s_tpu.data.images import set_metrics as _images_set_metrics
+
+        _images_set_metrics(self.metrics)
         self.controller = TPUJobController(
             self.clientset,
             allocator=self.allocator,

@@ -37,7 +37,13 @@ def _read_texts(patterns: List[str]) -> List[str]:
     paths = sorted({p for pat in patterns for p in glob.glob(pat)})
     if not paths:
         raise FileNotFoundError(f"no files match {patterns}")
-    return [open(p, encoding="utf-8").read() for p in paths]
+    texts = []
+    for p in paths:
+        # with-block per file: handles close deterministically instead
+        # of leaking until GC (ADVICE r5)
+        with open(p, encoding="utf-8") as f:
+            texts.append(f.read())
+    return texts
 
 
 def get_tokenizer(

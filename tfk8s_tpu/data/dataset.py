@@ -139,9 +139,9 @@ class RecordDataset:
         stop = n - (n % self.batch_size) if self.drop_remainder else n
         for lo in range(start_batch * self.batch_size, stop, self.batch_size):
             take = order[lo : lo + self.batch_size]
-            yield self._load(take)
+            yield self._load(take, epoch)
 
-    def _load(self, take: np.ndarray) -> Dict[str, np.ndarray]:
+    def _load(self, take: np.ndarray, epoch: int = 0) -> Dict[str, np.ndarray]:
         # group indices by shard, bulk-read each, then restore batch order
         by_shard: Dict[int, List[int]] = {}
         slots: List[Tuple[int, int]] = []  # (shard, position-in-group)
@@ -160,7 +160,11 @@ class RecordDataset:
         # pure-Python codec fallback) instead of inferring it from step
         # time
         self.bytes_read += sum(sum(len(r) for r in rs) for rs in raw.values())
-        examples = [self.decode(raw[si][pos]) for si, pos in slots]
+        examples = self._decode_records(
+            [raw[si][pos] for si, pos in slots],
+            [int(g) for g in take],
+            epoch,
+        )
         keys = examples[0].keys()
         for ex in examples[1:]:
             if ex.keys() != keys:
@@ -168,7 +172,41 @@ class RecordDataset:
                     f"inconsistent example keys: {sorted(keys)} vs "
                     f"{sorted(ex.keys())}"
                 )
-        return {k: np.stack([ex[k] for ex in examples]) for k in keys}
+        out = {}
+        for k in keys:
+            vals = [ex[k] for ex in examples]
+            shapes = {np.shape(v) for v in vals}
+            if len(shapes) > 1:
+                hint = (
+                    " — these look like IMAGE records (data/images); set "
+                    "input_format='image' (TFK8S_INPUT_FORMAT=image) so "
+                    "they decode instead of batching raw bytes"
+                    if k.startswith("image/")
+                    else ""
+                )
+                raise ValueError(
+                    f"records disagree on {k!r} shape ({sorted(shapes)[:4]}"
+                    f"...): ragged examples cannot stack into a batch{hint}"
+                )
+            out[k] = np.stack(vals)
+        return out
+
+    def _decode_records(
+        self, records: List[bytes], record_ids: List[int], epoch: int
+    ) -> List[Dict[str, np.ndarray]]:
+        """Record payloads -> example dicts, in batch order. The decode
+        STAGE of the pipeline, overridable by datasets whose decode is
+        expensive enough to parallelize (images.ImageDataset runs this
+        over a worker pool). ``record_ids`` are the dataset-global
+        record indices and ``epoch`` the shuffle epoch — together the
+        position-independent identity a subclass needs to seed
+        per-record augmentation deterministically across resume."""
+        return [self.decode(r) for r in records]
+
+    def close(self) -> None:
+        """Release any decode resources (worker pools). The base
+        dataset holds none — a no-op so every consumer can close
+        unconditionally."""
 
     def iterator(self, prefetch: int = 2, start_batch: int = 0):
         """An endless batch iterator cycling epochs. ``prefetch > 0``

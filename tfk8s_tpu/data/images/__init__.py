@@ -1,0 +1,70 @@
+"""Image data plane: real JPEG/PNG records, decode + augmentation.
+
+The north star trains "ResNet-50 ImageNet" (BASELINE.json) — this
+package closes the gap between the recordio substrate (which sustains
+GB/s but had never fed a real image) and the vision models (which
+trained on synthetic template-plus-noise tensors):
+
+- ``schema``   — the image Example layout (encoded JPEG/PNG bytes +
+  label + shape metadata) on the ``data/example.py`` codec, plus the
+  shard writer that packs ImageNet-style trees into recordio shards;
+- ``decode``   — compressed bytes -> HWC uint8 RGB (PIL-backed; the
+  ``native/recordio.cc`` g++ lazy-build pattern is the designated fast
+  path when a libjpeg-turbo core lands);
+- ``transforms`` — random-resized-crop / horizontal-flip / per-channel
+  normalize for training, resize + center-crop for eval, all
+  seed-deterministic for resume;
+- ``pipeline`` — ``ImageDataset``: a ``RecordDataset`` whose decode
+  stage fans out over a bounded worker pool, exporting
+  ``tfk8s_images_decoded_total`` / decode-seconds / queue-depth through
+  the obs metrics registry;
+- ``pack``     — the CLI (``python -m tfk8s_tpu.data.images.pack``)
+  packing a class-per-subdir image tree (or a synthetic JPEG set) into
+  training shards.
+"""
+
+from tfk8s_tpu.data.images.decode import (  # noqa: F401
+    ImageDecodeError,
+    decode_image,
+    encode_jpeg,
+    encode_png,
+)
+from tfk8s_tpu.data.images.pipeline import (  # noqa: F401
+    ImageDataset,
+    get_metrics,
+    set_metrics,
+)
+from tfk8s_tpu.data.images.schema import (  # noqa: F401
+    ImageExample,
+    ImageSchemaError,
+    decode_image_example,
+    encode_image_example,
+    is_image_example,
+    write_image_shards,
+)
+from tfk8s_tpu.data.images.transforms import (  # noqa: F401
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    eval_transform,
+    train_transform,
+)
+
+__all__ = [
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "ImageDataset",
+    "ImageDecodeError",
+    "ImageExample",
+    "ImageSchemaError",
+    "decode_image",
+    "decode_image_example",
+    "encode_image_example",
+    "encode_jpeg",
+    "encode_png",
+    "eval_transform",
+    "get_metrics",
+    "is_image_example",
+    "set_metrics",
+    "train_transform",
+    "write_image_shards",
+]

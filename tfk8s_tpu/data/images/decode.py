@@ -1,0 +1,99 @@
+"""Compressed image bytes -> HWC uint8 RGB arrays (and back, for the
+packer/bench/tests).
+
+PIL-backed: the decode hot loop holds the GIL only for the Python glue —
+libjpeg/zlib run with it released, which is what lets the
+``pipeline.ImageDataset`` worker pool scale past one core. A native
+libjpeg-turbo core via the ``native/recordio.cc`` g++ lazy-build pattern
+is the designated fast path if PIL decode ever becomes the measured
+input ceiling (see ROADMAP.md); this module is the seam it would slot
+into — callers depend on ``decode_image``/``open_image`` only.
+
+PIL is baked into the training image but gated here anyway: control
+plane code paths (operator, apiserver) must import cleanly on hosts
+without it.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:  # gate, don't hard-require: the control plane never decodes
+    from PIL import Image as _PILImage
+except Exception:  # noqa: BLE001 — any import failure means "no PIL"
+    _PILImage = None
+
+
+class ImageDecodeError(ValueError):
+    """Bytes that do not decode as an image (corrupt or wrong schema)."""
+
+
+def _require_pil():
+    if _PILImage is None:
+        raise ImageDecodeError(
+            "image decode needs Pillow, which is not importable here — "
+            "install it in the training image (control-plane hosts don't "
+            "need it)"
+        )
+    return _PILImage
+
+
+def open_image(encoded: bytes):
+    """Compressed bytes -> PIL RGB image (the transform stages crop on
+    the PIL object BEFORE materializing pixels — cheaper than decoding
+    to a full array first)."""
+    Image = _require_pil()
+    try:
+        img = Image.open(io.BytesIO(encoded))
+        img.load()
+    except Exception as exc:  # noqa: BLE001 — PIL raises a zoo of types
+        raise ImageDecodeError(f"undecodable image bytes: {exc}") from exc
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return img
+
+
+def image_size(encoded: bytes) -> Tuple[int, int, int]:
+    """(height, width, channels) from the container HEADER only — no
+    full decode (the packer stamps geometry into every record)."""
+    Image = _require_pil()
+    try:
+        with Image.open(io.BytesIO(encoded)) as img:
+            w, h = img.size
+            bands = len(img.getbands())
+    except Exception as exc:  # noqa: BLE001
+        raise ImageDecodeError(f"unreadable image header: {exc}") from exc
+    return h, w, bands
+
+
+def decode_image(encoded: bytes) -> np.ndarray:
+    """Compressed bytes -> HWC uint8 RGB array."""
+    return np.asarray(open_image(encoded), dtype=np.uint8)
+
+
+def encode_jpeg(array: np.ndarray, quality: int = 90) -> bytes:
+    """HWC uint8 RGB -> JPEG bytes (packer/bench/test helper)."""
+    Image = _require_pil()
+    buf = io.BytesIO()
+    Image.fromarray(np.asarray(array, np.uint8), "RGB").save(
+        buf, format="JPEG", quality=quality
+    )
+    return buf.getvalue()
+
+
+def encode_png(array: np.ndarray) -> bytes:
+    """HWC uint8 RGB -> PNG bytes (lossless — the golden-decode tests
+    pin exact pixels through this path)."""
+    Image = _require_pil()
+    buf = io.BytesIO()
+    Image.fromarray(np.asarray(array, np.uint8), "RGB").save(
+        buf, format="PNG"
+    )
+    return buf.getvalue()
+
+
+def have_decoder() -> bool:
+    return _PILImage is not None

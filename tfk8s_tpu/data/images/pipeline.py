@@ -1,0 +1,267 @@
+"""ImageDataset: record shards of compressed images -> augmented
+float32 batches, decoded on a bounded worker pool.
+
+A ``RecordDataset`` whose decode stage (``_decode_records``) fans each
+batch's images out over a ``ThreadPoolExecutor`` — PIL's libjpeg/zlib
+loops release the GIL, so W workers buy close to W-way decode
+parallelism without processes. Augmentation is seeded per
+``(dataset seed, epoch, record index)``: position-independent, so a
+resumed run (``iterator(start_batch=...)`` fast-forward) replays the
+IDENTICAL pixel stream the uninterrupted run would have produced, and
+any worker-pool scheduling order yields the same batch.
+
+Observability (the PR-1 obs layer): pass the process's ``Metrics``
+registry to :func:`set_metrics` (the operator server wires its own in
+``cmd/server.py``) and the pipeline exports
+
+- ``tfk8s_images_decoded_total{mode=train|eval}`` — images decoded
+- ``tfk8s_image_decode_errors_total`` — records that failed to decode
+- ``tfk8s_image_decode_seconds`` — per-batch decode+augment wall time
+- ``tfk8s_image_decode_queue_depth`` — staged batches in the prefetch
+  queue (the input-starvation early-warning: a queue pinned at 0 means
+  the decode pool, not the trainer, is the bottleneck)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tfk8s_tpu.data.dataset import RecordDataset
+from tfk8s_tpu.data.images import schema
+from tfk8s_tpu.data.images.decode import ImageDecodeError, open_image
+from tfk8s_tpu.data.images.transforms import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    eval_transform,
+    train_transform,
+)
+
+# decouples the augmentation rng stream from the shuffle stream (which
+# folds [seed, epoch] in RecordDataset._epoch_order)
+_AUG_SALT = 0x1A6E5EED
+
+_metrics = None
+_metrics_lock = threading.Lock()
+
+
+def set_metrics(registry) -> None:
+    """Install the process's obs ``Metrics`` registry (utils/logging) as
+    the sink for the pipeline's decode metrics. None disables."""
+    global _metrics
+    with _metrics_lock:
+        _metrics = registry
+        if registry is not None:
+            registry.describe(
+                "tfk8s_images_decoded_total",
+                "Images decoded by the input pipeline",
+            )
+            registry.describe(
+                "tfk8s_image_decode_seconds",
+                "Wall time of one batch decode+augment",
+            )
+            registry.describe(
+                "tfk8s_image_decode_queue_depth",
+                "Decoded batches staged in the prefetch queue",
+            )
+            registry.describe(
+                "tfk8s_image_decode_errors_total",
+                "Records that failed image decode (corrupt or wrong schema)",
+            )
+
+
+def get_metrics():
+    return _metrics
+
+
+def default_workers() -> int:
+    """Decode pool width: every core up to 8 — past that, JPEG decode on
+    one host is usually no longer the binding constraint and the threads
+    just contend with the trainer's own host work."""
+    return max(min(os.cpu_count() or 1, 8), 1)
+
+
+class ImageDataset(RecordDataset):
+    """Shard-assigned, shuffled, batched IMAGE input: each record is an
+    image Example (``schema.py``); batches come out as
+    ``{"image": float32 [B, size, size, 3], "label": int32 [B]}`` —
+    exactly the host-batch schema ``models/resnet.py`` and
+    ``models/vit.py`` train on.
+
+    ``train=True`` applies the seeded training augmentation
+    (random-resized-crop + flip + normalize); ``train=False`` the
+    deterministic eval view (resize + center-crop). All RecordDataset
+    semantics (per-host file/record sharding, seeded epoch shuffle,
+    resume fast-forward) carry over unchanged.
+    """
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        batch_size: int,
+        image_size: int,
+        train: bool = True,
+        workers: Optional[int] = None,
+        host_index: int = 0,
+        num_hosts: int = 1,
+        seed: int = 0,
+        shuffle: Optional[bool] = None,
+        drop_remainder: bool = True,
+        verify_crc: bool = True,
+        shard_by: str = "auto",
+        do_normalize: bool = True,
+        min_scale: float = 0.08,
+    ):
+        super().__init__(
+            files,
+            batch_size,
+            host_index=host_index,
+            num_hosts=num_hosts,
+            seed=seed,
+            # eval wants the stable unshuffled order unless told otherwise
+            shuffle=train if shuffle is None else shuffle,
+            decode=schema.decode_image_example,  # per-record, pre-pixels
+            drop_remainder=drop_remainder,
+            verify_crc=verify_crc,
+            shard_by=shard_by,
+        )
+        if image_size < 1:
+            raise ValueError(f"image_size must be >= 1, got {image_size}")
+        self.image_size = image_size
+        self.train = train
+        self.do_normalize = do_normalize
+        self.min_scale = min_scale  # RRC area floor (transforms.py)
+        self.workers = workers or default_workers()
+        self.images_decoded = 0  # cumulative (windowed-rate source)
+        self.decoded_bytes = 0  # decoded float32 bytes produced
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_lock = threading.Lock()
+
+    # -- decode stage -------------------------------------------------------
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.workers,
+                    thread_name_prefix="img-decode",
+                )
+            return self._pool
+
+    def _decode_one(
+        self, record: bytes, record_id: int, epoch: int
+    ) -> Dict[str, np.ndarray]:
+        try:
+            ex = self.decode(record)
+            img = open_image(ex.encoded)
+            if self.train:
+                rng = np.random.default_rng(
+                    np.random.SeedSequence(
+                        [self.seed, _AUG_SALT, epoch, record_id]
+                    )
+                )
+                pixels = train_transform(
+                    img, rng, self.image_size, self.do_normalize,
+                    min_scale=self.min_scale,
+                )
+            else:
+                pixels = eval_transform(
+                    img, self.image_size, self.do_normalize
+                )
+        except (ImageDecodeError, schema.ImageSchemaError) as exc:
+            m = get_metrics()
+            if m is not None:
+                m.inc("tfk8s_image_decode_errors_total")
+            raise ImageDecodeError(
+                f"record {record_id} of shard set {self.files}: {exc}"
+            ) from exc
+        return {
+            "image": pixels,
+            "label": np.int32(ex.label),
+        }
+
+    def _decode_records(
+        self, records: List[bytes], record_ids: List[int], epoch: int
+    ) -> List[Dict[str, np.ndarray]]:
+        t0 = time.perf_counter()
+        if len(records) == 1 or self.workers == 1:
+            out = [
+                self._decode_one(r, rid, epoch)
+                for r, rid in zip(records, record_ids)
+            ]
+        else:
+            pool = self._ensure_pool()
+            out = list(
+                pool.map(
+                    self._decode_one,
+                    records,
+                    record_ids,
+                    [epoch] * len(records),
+                )
+            )
+        self.images_decoded += len(out)
+        self.decoded_bytes += sum(ex["image"].nbytes for ex in out)
+        m = get_metrics()
+        if m is not None:
+            mode = "train" if self.train else "eval"
+            m.inc(
+                "tfk8s_images_decoded_total", float(len(out)),
+                labels={"mode": mode},
+            )
+            m.observe(
+                "tfk8s_image_decode_seconds", time.perf_counter() - t0,
+                labels={"mode": mode},
+            )
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def iterator(self, prefetch: int = 2, start_batch: int = 0):
+        it = super().iterator(prefetch, start_batch)
+        if prefetch > 0:
+            return _QueueDepthIterator(it)
+        return it
+
+    def close(self) -> None:
+        """Shut the decode pool down (joins idle workers — no leaked
+        threads after the run; the e2e tests assert this)."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def __del__(self):  # best-effort: a dropped dataset must not pin threads
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
+
+class _QueueDepthIterator:
+    """Prefetch-iterator wrapper exporting the staged-batch count as the
+    ``tfk8s_image_decode_queue_depth`` gauge on every dequeue."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._inner)
+        m = get_metrics()
+        if m is not None:
+            q = getattr(self._inner, "_q", None)
+            if q is not None:
+                m.set_gauge(
+                    "tfk8s_image_decode_queue_depth", float(q.qsize())
+                )
+        return item
+
+    def close(self) -> None:
+        self._inner.close()

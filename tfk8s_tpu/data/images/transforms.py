@@ -1,0 +1,145 @@
+"""Image transforms: the standard ImageNet training recipe (random
+resized crop + horizontal flip + per-channel normalize) and its
+deterministic eval counterpart (resize shorter side + center crop).
+
+Seed discipline: every random choice draws from the ``np.random
+.Generator`` the caller passes — no module/global state — so the
+pipeline can derive one generator per (seed, epoch, record) and a
+resumed run replays the IDENTICAL augmentation stream (the same
+property the record shuffle in ``data/dataset.py`` has). Crops happen
+on the PIL object before pixels materialize: cropping a 500x375 JPEG to
+a 224 training crop touches ~1/3 of the pixels a decode-then-crop
+pipeline would.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+# ImageNet per-channel statistics (the constants every pretrained-vision
+# pipeline normalizes with)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+# eval resizes the shorter side to size * (256/224) before the center
+# crop — the canonical 256-resize/224-crop ratio, kept exact for any
+# target size
+_EVAL_RESIZE_RATIO = 256 / 224
+
+
+def _as_pil(img):
+    from tfk8s_tpu.data.images.decode import _require_pil
+
+    Image = _require_pil()
+    if isinstance(img, np.ndarray):
+        return Image.fromarray(np.asarray(img, np.uint8), "RGB")
+    return img
+
+
+def _bilinear():
+    from tfk8s_tpu.data.images.decode import _require_pil
+
+    Image = _require_pil()
+    # Pillow >= 9.1 moved resample filters to Image.Resampling
+    return getattr(Image, "Resampling", Image).BILINEAR
+
+
+def sample_crop(
+    rng: np.random.Generator,
+    height: int,
+    width: int,
+    scale: Tuple[float, float] = (0.08, 1.0),
+    ratio: Tuple[float, float] = (3 / 4, 4 / 3),
+    attempts: int = 10,
+) -> Tuple[int, int, int, int]:
+    """The random-resized-crop box (top, left, h, w): area uniform in
+    ``scale`` x image area, aspect log-uniform in ``ratio``; after
+    ``attempts`` rejections fall back to the largest in-ratio center
+    crop (torchvision's exact fallback, so tail-shaped images don't
+    bias toward tiny crops)."""
+    area = height * width
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(attempts):
+        target = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(*log_ratio))
+        w = int(round(math.sqrt(target * aspect)))
+        h = int(round(math.sqrt(target / aspect)))
+        if 0 < w <= width and 0 < h <= height:
+            top = int(rng.integers(0, height - h + 1))
+            left = int(rng.integers(0, width - w + 1))
+            return top, left, h, w
+    in_ratio = width / height
+    if in_ratio < ratio[0]:
+        w, h = width, int(round(width / ratio[0]))
+    elif in_ratio > ratio[1]:
+        w, h = int(round(height * ratio[1])), height
+    else:
+        w, h = width, height
+    return (height - h) // 2, (width - w) // 2, h, w
+
+
+def normalize(
+    pixels: np.ndarray,
+    mean: Tuple[float, float, float] = IMAGENET_MEAN,
+    std: Tuple[float, float, float] = IMAGENET_STD,
+) -> np.ndarray:
+    """uint8 HWC -> float32 HWC, scaled to [0,1] then per-channel
+    standardized."""
+    out = np.asarray(pixels, np.float32) / 255.0
+    out -= np.asarray(mean, np.float32)
+    out /= np.asarray(std, np.float32)
+    return out
+
+
+def train_transform(
+    img: Union[np.ndarray, "object"],
+    rng: np.random.Generator,
+    size: int,
+    do_normalize: bool = True,
+    min_scale: float = 0.08,
+) -> np.ndarray:
+    """Random-resized-crop to ``size`` + horizontal flip (p=0.5) +
+    normalize -> float32 [size, size, 3]. Consumes exactly the same
+    rng draws regardless of image geometry (crop box, then one flip
+    draw), so the stream stays aligned across datasets.
+
+    ``min_scale`` is the crop-area floor: 0.08 is the ImageNet
+    standard (224px natural images, ~1.3M samples); small/synthetic
+    datasets usually want a gentler 0.3-0.6 — an 8%-area crop of a
+    28px image is an 8px blob, and a toy task trained on those stops
+    converging (regularization outweighing signal)."""
+    pil = _as_pil(img)
+    w, h = pil.size
+    top, left, ch, cw = sample_crop(rng, h, w, scale=(min_scale, 1.0))
+    flip = bool(rng.integers(0, 2))
+    pil = pil.resize(
+        (size, size), _bilinear(), box=(left, top, left + cw, top + ch)
+    )
+    out = np.asarray(pil, np.uint8)
+    if flip:
+        out = out[:, ::-1]
+    return normalize(out) if do_normalize else np.asarray(out, np.float32)
+
+
+def eval_transform(
+    img: Union[np.ndarray, "object"],
+    size: int,
+    do_normalize: bool = True,
+) -> np.ndarray:
+    """Deterministic eval view: shorter side to ``size * 256/224``,
+    center crop ``size`` -> float32 [size, size, 3]."""
+    pil = _as_pil(img)
+    w, h = pil.size
+    short = max(int(round(size * _EVAL_RESIZE_RATIO)), size)
+    if w <= h:
+        rw, rh = short, max(int(round(h * short / w)), short)
+    else:
+        rw, rh = max(int(round(w * short / h)), short), short
+    pil = pil.resize((rw, rh), _bilinear())
+    left, top = (rw - size) // 2, (rh - size) // 2
+    pil = pil.crop((left, top, left + size, top + size))
+    out = np.asarray(pil, np.uint8)
+    return normalize(out) if do_normalize else np.asarray(out, np.float32)

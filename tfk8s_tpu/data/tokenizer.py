@@ -68,6 +68,15 @@ def _to_byte_chars(text: str) -> str:
     return "".join(_BYTE_ENC[b] for b in text.encode("utf-8"))
 
 
+class VocabMismatchError(KeyError):
+    """A merge-produced piece has no vocab id — vocab.json and
+    merges.txt are from different tokenizers. Subclasses KeyError so
+    pre-existing callers catching the bare KeyError keep working."""
+
+    def __str__(self) -> str:  # KeyError repr()s its arg; keep the prose
+        return self.args[0] if self.args else ""
+
+
 def _apply_merge(symbols: Sequence[str], pair: Tuple[str, str]) -> List[str]:
     """One left-to-right pass replacing adjacent ``pair`` occurrences with
     their concatenation — the ONE merge-application used by both encoding
@@ -100,6 +109,19 @@ class BPETokenizer:
         self.ranks = {tuple(m): i for i, m in enumerate(merges)}
         self.merges = [tuple(m) for m in merges]
         self.specials = list(specials)
+        # longest-first alternation so overlapping specials resolve to
+        # the longest match (HF AddedToken behavior); None when there
+        # are no specials to split out
+        self._special_re = (
+            re.compile(
+                "|".join(
+                    re.escape(s)
+                    for s in sorted(self.specials, key=len, reverse=True)
+                )
+            )
+            if self.specials
+            else None
+        )
         self._cache: Dict[str, List[str]] = {}
 
     @property
@@ -122,12 +144,49 @@ class BPETokenizer:
             self._cache[word] = parts
         return parts
 
+    def _lookup(self, piece: str) -> int:
+        try:
+            return self.vocab[piece]
+        except KeyError:
+            raise VocabMismatchError(
+                f"BPE piece {piece!r} is missing from the vocab "
+                f"({len(self.vocab)} entries) although the merge list "
+                "produced it — vocab.json and merges.txt are almost "
+                "certainly from DIFFERENT tokenizers; re-export the pair "
+                "together"
+            ) from None
+
     def encode(self, text: str) -> List[int]:
+        """Text -> ids. Special tokens appearing IN the text (e.g.
+        ``<|endoftext|>`` as a document separator) encode atomically to
+        their reserved ids instead of being BPE-split — matching HF
+        added-token behavior, so callers other than corpus.py (which
+        appends the EOS id directly) get the same stream."""
         ids: List[int] = []
-        for tok in _PRETOKEN.findall(text):
-            for piece in self._bpe(_to_byte_chars(tok)):
-                ids.append(self.vocab[piece])
+        for chunk, special in self._split_specials(text):
+            if special:
+                ids.append(self._lookup(chunk))
+                continue
+            for tok in _PRETOKEN.findall(chunk):
+                for piece in self._bpe(_to_byte_chars(tok)):
+                    ids.append(self._lookup(piece))
         return ids
+
+    def _split_specials(self, text: str) -> List[Tuple[str, bool]]:
+        """Split ``text`` into (chunk, is_special) runs; specials match
+        longest-first and never cross BPE pre-tokenization."""
+        if self._special_re is None:
+            return [(text, False)]
+        out: List[Tuple[str, bool]] = []
+        pos = 0
+        for m in self._special_re.finditer(text):
+            if m.start() > pos:
+                out.append((text[pos : m.start()], False))
+            out.append((m.group(), True))
+            pos = m.end()
+        if pos < len(text):
+            out.append((text[pos:], False))
+        return out
 
     def decode(self, ids: Iterable[int]) -> str:
         chars = "".join(self.inv_vocab[int(i)] for i in ids)
@@ -144,6 +203,15 @@ class BPETokenizer:
             f.write("#version: 0.2\n")
             for a, b in self.merges:
                 f.write(f"{a} {b}\n")
+        # The HF layout has no positional-specials manifest; persist ours
+        # so ARBITRARY special shapes (e.g. "[PAD]") survive a save/load
+        # round trip with atomic encoding intact. Written even when
+        # EMPTY: an explicit [] tells load() "no specials" — otherwise
+        # its <|...|>-shape fallback could mint a phantom special out of
+        # a vocab piece that merely LOOKS like one (a corpus containing
+        # the literal text), silently changing the reloaded id stream.
+        with open(os.path.join(directory, "special_tokens.json"), "w") as f:
+            json.dump(self.specials, f, ensure_ascii=False)
 
     @classmethod
     def load(cls, directory: str) -> "BPETokenizer":
@@ -157,7 +225,19 @@ class BPETokenizer:
                     continue
                 a, _, b = line.partition(" ")
                 merges.append((a, b))
-        return cls(vocab, merges)
+        # specials: the save()-written manifest when present (possibly
+        # an explicit empty list); else recover reserved tokens by their
+        # ``<|...|>`` shape (plain HF directories / pre-manifest saves)
+        # so a reloaded tokenizer still encodes them atomically
+        manifest = os.path.join(directory, "special_tokens.json")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                specials = json.load(f)
+        else:
+            specials = [
+                t for t in vocab if t.startswith("<|") and t.endswith("|>")
+            ]
+        return cls(vocab, merges, specials=specials)
 
 
 def train_bpe(

@@ -194,20 +194,24 @@ _TEMPLATE_SEED = 4321
 
 
 @functools.lru_cache(maxsize=None)
-def _templates(num_classes: int, image_size: int) -> np.ndarray:
-    rng = np.random.default_rng(_TEMPLATE_SEED)
-    return rng.standard_normal((num_classes, image_size, image_size, 3)).astype(
-        np.float32
+def _template(cls: int, image_size: int) -> np.ndarray:
+    """ONE class's template, generated lazily from a per-class seed.
+    Memory tracks the classes actually sampled: the files-input schema
+    probe (make_batch of ONE row) used to pay for the whole bank — at
+    the shipped ImageNet config that was a ~600 MB allocation per worker
+    for a pipeline that never trains on synthetic data."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([_TEMPLATE_SEED, cls, image_size])
     )
+    return rng.standard_normal((image_size, image_size, 3)).astype(np.float32)
 
 
 def make_batch_fn(num_classes: int, image_size: int):
-    temps = _templates(num_classes, image_size)
-
     def make_batch(rng: np.random.Generator, batch_size: int) -> Dict[str, np.ndarray]:
         y = rng.integers(0, num_classes, size=(batch_size,), dtype=np.int64)
         noise = rng.standard_normal((batch_size, image_size, image_size, 3))
-        x = (0.6 * temps[y] + noise).astype(np.float32)
+        temps = np.stack([_template(int(c), image_size) for c in y])
+        x = (0.6 * temps + noise).astype(np.float32)
         return {"image": x, "label": y.astype(np.int32)}
 
     return make_batch
@@ -247,13 +251,34 @@ def make_task(
 
 
 def train(env: Dict[str, str], stop: Optional[Any] = None) -> None:
-    """TPUJob entrypoint: ``tfk8s_tpu.models.resnet:train``."""
+    """TPUJob entrypoint: ``tfk8s_tpu.models.resnet:train``.
+
+    With ``TFK8S_INPUT_FILES`` + ``TFK8S_INPUT_FORMAT=image`` the job
+    trains from PACKED IMAGE SHARDS (data/images: JPEG decode + seeded
+    augmentation on a worker pool) instead of the synthetic generator —
+    the files-input manifest ``manifests/examples/resnet50-images.yaml``
+    rides this. ``TFK8S_NUM_CLASSES`` must then match the packed
+    ``labels.json``; ``TFK8S_TARGET_ACCURACY`` turns the run into a
+    convergence check (the pod FAILS when training misses it)."""
     env = dict(env)
     env.setdefault("TFK8S_TRAIN_STEPS", "100")
     env.setdefault("TFK8S_LEARNING_RATE", "1e-3")
     depth = int(env.get("TFK8S_RESNET_DEPTH", "50"))
     batch = int(env.get("TFK8S_BATCH_SIZE", "256"))
     image = int(env.get("TFK8S_IMAGE_SIZE", "224"))
+    num_classes = int(env.get("TFK8S_NUM_CLASSES", "1000"))
+    width = int(env.get("TFK8S_RESNET_WIDTH", "64"))
     run_task(
-        make_task(depth=depth, batch_size=batch, image_size=image), env, stop
+        make_task(
+            depth=depth,
+            batch_size=batch,
+            image_size=image,
+            num_classes=num_classes,
+            width=width,
+            targets={"accuracy": float(env["TFK8S_TARGET_ACCURACY"])}
+            if env.get("TFK8S_TARGET_ACCURACY")
+            else None,
+        ),
+        env,
+        stop,
     )

@@ -11,7 +11,11 @@ embedding plus a head around the existing stack, not a new stack.
 
 Hermetic data: the class-conditional template images ResNet trains on
 (models/resnet.make_batch_fn), so the two vision families are directly
-comparable on one task.
+comparable on one task. With ``TFK8S_INPUT_FILES`` +
+``TFK8S_INPUT_FORMAT=image`` the same entrypoint instead trains from
+PACKED IMAGE SHARDS through the shared files-input mode (data/images
+decode + augmentation pool) — the batch schema is identical, so the
+swap is configuration, not code.
 """
 
 from __future__ import annotations

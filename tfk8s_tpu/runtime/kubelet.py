@@ -363,13 +363,27 @@ class LocalKubelet:
             except NotFound:
                 return False
             except Conflict:
+                # Bounded by the SAME deadline as outages, not a fixed
+                # count: each iteration re-reads and can succeed, so 409s
+                # accumulated across a long outage must never abort a
+                # terminal SUCCEEDED/FAILED write (ADVICE r5 — the exact
+                # dropped-outcome this loop exists to prevent). The brief
+                # pause keeps a racing writer from turning this into a
+                # hot re-read loop.
                 conflicts += 1
-                if conflicts > 5:
+                if time.monotonic() > deadline:
                     log.warning(
-                        "%s: giving up updating %s to %s (conflicts)",
-                        self.name, pod_key, phase,
+                        "%s: giving up updating %s to %s (%d conflicts, "
+                        "deadline exceeded)",
+                        self.name, pod_key, phase, conflicts,
                     )
                     return False
+                # real sleep, NOT _stop.wait: conflicts are retried even
+                # during shutdown (the final phases are the point of
+                # stopping gracefully), and wait() on a set event returns
+                # immediately — which would turn this into the hot
+                # re-read loop the pause exists to prevent
+                time.sleep(0.05)
                 continue
             except (Unavailable, OSError) as e:
                 stopping = self._stop is not None and self._stop.is_set()

@@ -168,6 +168,21 @@ class TrainConfig:
     # comma-separated record-file paths/globs for input_mode="files"
     # (TFK8S_INPUT_FILES); examples must decode to the task's batch schema
     input_files: Optional[str] = None
+    # What the record shards HOLD (TFK8S_INPUT_FORMAT):
+    # - "array" (default): example.py array dicts decoding straight to
+    #   the task's batch schema (the text families' packed token rows);
+    # - "image": compressed JPEG/PNG image Examples (data/images) —
+    #   decoded + augmented on a worker pool into the
+    #   {"image": f32 [B,S,S,3], "label": i32 [B]} schema the vision
+    #   tasks train on, replacing their synthetic generator. The target
+    #   image size is read off the task's own example batch.
+    input_format: str = "array"
+    # image-decode pool width (TFK8S_DECODE_WORKERS; None = auto)
+    decode_workers: Optional[int] = None
+    # random-resized-crop area floor (TFK8S_AUG_MIN_SCALE): 0.08 is the
+    # ImageNet-standard augmentation; small/synthetic image sets train
+    # better around 0.3-0.6 (see data/images/transforms.train_transform)
+    aug_min_scale: float = 0.08
 
     # Learning-rate decay after warmup: "constant" (default), "cosine"
     # (to min_lr_ratio * learning_rate over decay_steps), or "linear".
@@ -295,6 +310,55 @@ class _CheckedFileStream:
 
     def close(self) -> None:
         self._it.close()
+        if self.dataset is not None:
+            # releases any decode worker pool (images input); no-op for
+            # plain record datasets
+            self.dataset.close()
+
+
+def _image_geometry(want_example) -> int:
+    """Target decode size from a vision task's own example batch: the
+    ``image`` leaf must be square [*, S, S, 3] float32 — the contract
+    ``models/resnet.py``/``models/vit.py`` batches satisfy. Failing here
+    names the actual mismatch instead of letting a non-vision task fall
+    into the image decoder."""
+    leaf = (want_example or {}).get("image") if isinstance(want_example, dict) else None
+    if leaf is None:
+        raise ValueError(
+            'input_format="image" needs a task whose batch schema has an '
+            '"image" leaf (the vision families); this task has '
+            f"{sorted(want_example.keys()) if isinstance(want_example, dict) else type(want_example)}"
+        )
+    shape = np.asarray(leaf).shape
+    if len(shape) != 4 or shape[1] != shape[2] or shape[3] != 3:
+        raise ValueError(
+            f"image input needs a square [B, S, S, 3] image leaf, task "
+            f"expects {list(shape)}"
+        )
+    return int(shape[1])
+
+
+def _open_image_dataset(
+    paths, local_rows: int, want_example, *, train: bool, seed: int = 0,
+    workers: Optional[int] = None, min_scale: float = 0.08,
+    host_index: int = 0, num_hosts: int = 1,
+):
+    """Build the decode+augment pipeline (data/images.ImageDataset) over
+    ``paths`` sized to this process's rows, targeting the geometry the
+    task's batch schema declares."""
+    from tfk8s_tpu.data.images import ImageDataset
+
+    return ImageDataset(
+        paths,
+        batch_size=local_rows,
+        image_size=_image_geometry(want_example),
+        train=train,
+        workers=workers,
+        host_index=host_index,
+        num_hosts=num_hosts,
+        seed=seed,
+        min_scale=min_scale,
+    )
 
 
 class _BatchPrefetcher:
@@ -352,6 +416,12 @@ class _BatchPrefetcher:
                 raise self._exc
             raise RuntimeError("batch prefetcher exhausted early")
         return item
+
+    def depth(self) -> int:
+        """Batches currently staged (the input-starvation early-warning:
+        pinned at 0 means the producer, not the device, is the
+        bottleneck)."""
+        return self._q.qsize()
 
     def close(self) -> None:
         self._stop.set()
@@ -671,13 +741,25 @@ class Trainer:
             self.input_shard_range = (shard_lo, shard_hi, num_shards)
         else:
             local_rows = task.batch_size
-        ds = RecordDataset(
-            paths,
-            batch_size=local_rows,
-            host_index=jax.process_index(),
-            num_hosts=nproc,
-            seed=cfg.seed,
-        )
+        want = self.task.make_batch(np.random.default_rng(0), 1)
+        if cfg.input_format == "image":
+            ds = _open_image_dataset(
+                paths, local_rows, want, train=True, seed=cfg.seed,
+                workers=cfg.decode_workers, min_scale=cfg.aug_min_scale,
+                host_index=jax.process_index(), num_hosts=nproc,
+            )
+        elif cfg.input_format == "array":
+            ds = RecordDataset(
+                paths,
+                batch_size=local_rows,
+                host_index=jax.process_index(),
+                num_hosts=nproc,
+                seed=cfg.seed,
+            )
+        else:
+            raise ValueError(
+                f"unknown input_format {cfg.input_format!r} (array | image)"
+            )
         if ds.shard_by == "records" and nproc > 1:
             # the auto fallback trades the 1/hosts file-IO property for
             # record striping (every process index-scans ALL files) —
@@ -690,19 +772,17 @@ class Trainer:
                 task.name, len(ds.files), nproc,
             )
         log.info(
-            "%s: file input (%s-sharded) — process %d/%d reads %d files / "
-            "%d records, %d rows/step, resuming at batch %d",
-            task.name, ds.shard_by, jax.process_index(), nproc,
-            len(ds.files), len(ds), local_rows, start_step,
+            "%s: %s file input (%s-sharded) — process %d/%d reads %d files "
+            "/ %d records, %d rows/step, resuming at batch %d",
+            task.name, cfg.input_format, ds.shard_by, jax.process_index(),
+            nproc, len(ds.files), len(ds), local_rows, start_step,
         )
         # prefetch=0: fit's own _BatchPrefetcher supplies the background
         # thread; a second producer here would double-buffer the batches
+        # (the image decode pool still parallelizes WITHIN each batch)
         it = ds.iterator(prefetch=0, start_batch=start_step)
 
-        return _CheckedFileStream(
-            it, self.task.make_batch(np.random.default_rng(0), 1), local_rows,
-            dataset=ds,
-        )
+        return _CheckedFileStream(it, want, local_rows, dataset=ds)
 
     def _make_shard_batch(self, step: int, shard_lo: int, shard_hi: int,
                           num_shards: int):
@@ -907,6 +987,7 @@ class Trainer:
         # cumulative average that still carries the first-step compile
         last_report = (start_step, t0)
         last_bytes = 0  # input-bandwidth window anchor (files input)
+        last_images = 0  # decoded-image window anchor (image input)
         # chunked device loop: scan_steps steps per dispatch, never
         # crossing a log/checkpoint boundary; profiling forces per-step
         # dispatch so the trace keeps step-level annotations
@@ -1066,6 +1147,31 @@ class Trainer:
                             (b_now - last_bytes) / w_dt / 1e6
                         )
                         last_bytes = b_now
+                        i_now = getattr(
+                            files_iter.dataset, "images_decoded", None
+                        )
+                        if i_now is not None:
+                            # the decode pool's delivered rate — an
+                            # operator alert can see the image-input
+                            # ceiling directly, next to input MB/s
+                            report_kw["decoded_images_per_sec"] = (
+                                (i_now - last_images) / w_dt
+                            )
+                            last_images = i_now
+                            if prefetcher is not None:
+                                # the staged-batch gauge on the WIRED
+                                # path: fit's own prefetcher is the
+                                # queue between decode and device here
+                                from tfk8s_tpu.data.images.pipeline import (
+                                    get_metrics as _img_metrics,
+                                )
+
+                                im = _img_metrics()
+                                if im is not None:
+                                    im.set_gauge(
+                                        "tfk8s_image_decode_queue_depth",
+                                        float(prefetcher.depth()),
+                                    )
                     progress.report(**report_kw)
                     log.info(
                         "%s step %d: %s", self.task.name, step,
@@ -1131,11 +1237,25 @@ def run_eval(
     if eval_files:
         from tfk8s_tpu.data.dataset import RecordDataset
 
-        eval_ds = RecordDataset(
-            _expand_input_files(eval_files),
-            batch_size=task.batch_size,
-            shuffle=False,
-        )
+        want = task.make_batch(np.random.default_rng(0), 1)
+        if env.get("TFK8S_INPUT_FORMAT", "array") == "image":
+            # the deterministic eval view (resize + center-crop,
+            # unshuffled) — every restore scores the SAME pixels
+            eval_ds = _open_image_dataset(
+                _expand_input_files(eval_files), task.batch_size, want,
+                train=False,
+                workers=(
+                    int(env["TFK8S_DECODE_WORKERS"])
+                    if env.get("TFK8S_DECODE_WORKERS")
+                    else None
+                ),
+            )
+        else:
+            eval_ds = RecordDataset(
+                _expand_input_files(eval_files),
+                batch_size=task.batch_size,
+                shuffle=False,
+            )
         avail = eval_ds.batches_per_epoch()
         if avail < eval_batches:
             log.info(
@@ -1146,12 +1266,9 @@ def run_eval(
         # materialize ONCE: the batches are identical for every
         # checkpoint by design (unshuffled epoch 0), so paying file IO +
         # CRC + decode + schema check per evaluation would be pure waste
-        checked = _CheckedFileStream(
-            eval_ds.batches(0),
-            task.make_batch(np.random.default_rng(0), 1),
-            task.batch_size,
-        )
+        checked = _CheckedFileStream(eval_ds.batches(0), want, task.batch_size)
         eval_set = [next(checked) for _ in range(eval_batches)]
+        eval_ds.close()  # decode pool is done once the set materializes
     ckpt = Checkpointer(ctx.checkpoint_dir)
 
     last_seen = -1
@@ -1259,6 +1376,13 @@ def _run_task_inner(
                 else None
             ),
             input_files=env.get("TFK8S_INPUT_FILES") or None,
+            input_format=env.get("TFK8S_INPUT_FORMAT", "array"),
+            decode_workers=(
+                int(env["TFK8S_DECODE_WORKERS"])
+                if env.get("TFK8S_DECODE_WORKERS")
+                else None
+            ),
+            aug_min_scale=float(env.get("TFK8S_AUG_MIN_SCALE", "0.08")),
             warmup_steps=int(env.get("TFK8S_WARMUP_STEPS", "0")),
             lr_schedule=env.get("TFK8S_LR_SCHEDULE", "constant"),
             decay_steps=(
