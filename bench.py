@@ -465,43 +465,71 @@ _IMAGE_BUDGET_IMG_S = 2447
 def _image_pipeline_probe(small: bool):
     """Image data-plane throughput on THIS host: pack a synthetic JPEG
     shard set (data/images/pack.py), then run the decode+augment worker
-    pool (ImageDataset) over one epoch and report delivered images/s and
-    decoded MB/s against the input budget. Host-side only. Returns None
-    when no image decoder is importable."""
+    pool (ImageDataset) over one epoch per row. Rows (all measured in
+    the SAME run, workers=1 so they read as per-worker img/s):
+
+    - delivered: the env-resolved backend at the default pool width —
+      the rate a training host actually gets, vs the input budget;
+    - per-backend: native vs PIL at the headline source size, plus the
+      native DCT-scaled-decode on/off pair;
+    - hi-res: the same backend pair on multi-megapixel sources (the
+      regime scaled decode exists for — a 1024px source bound for a
+      224px crop decodes at a fraction of the IDCT cost).
+
+    Host-side only. Returns None when no image decoder is importable;
+    the native rows are present only when the native core actually
+    loaded (TFK8S_PURE_PY / missing toolchain degrade to the PIL rows)."""
     import shutil
     import tempfile
 
     from tfk8s_tpu.data.images import ImageDataset, pack
+    from tfk8s_tpu.data.images import _native_decode
     from tfk8s_tpu.data.images.decode import have_decoder
 
     if not have_decoder():
         return None
     # small: tiny images for rc coverage; full: the headline 224px shape
-    n, size, classes, bs = (96, 64, 8, 32) if small else (1024, 224, 16, 64)
+    n, size, classes, bs = (96, 64, 8, 32) if small else (768, 224, 16, 64)
+    hi_n, hi_size = (48, 128) if small else (96, 1024)
+
+    def rate(paths, backend, scaled=True, workers=1, train_size=size):
+        ds = ImageDataset(
+            paths, batch_size=bs, image_size=train_size, train=True,
+            seed=0, workers=workers, backend=backend,
+            scaled_decode=scaled,
+        )
+        try:
+            next(iter(ds.batches(0)))  # warm: pool spin-up + page cache
+            decoded0, bytes0 = ds.images_decoded, ds.decoded_bytes
+            t0 = time.perf_counter()
+            for _ in ds.batches(0):
+                pass
+            dt = time.perf_counter() - t0
+            imgs = ds.images_decoded - decoded0
+            dec_mb = (ds.decoded_bytes - bytes0) / 1e6
+            return imgs / dt, dec_mb / dt, ds.workers, ds.backend
+        finally:
+            ds.close()  # a mid-measure decode error must not leak the pool
+
+    native = _native_decode.available()
     d = tempfile.mkdtemp(prefix="bench-images-")
     try:
         paths = pack.pack_synthetic(d, n, classes, size, 2, seed=0)
         shard_mb = sum(os.path.getsize(p) for p in paths) / 1e6
-        ds = ImageDataset(
-            paths, batch_size=bs, image_size=size, train=True, seed=0
+        # the delivered rate: env-resolved backend, default pool width
+        img_s, dec_mbps, pool_w, backend = rate(
+            paths, backend=None, workers=None
         )
-        next(iter(ds.batches(0)))  # warm: pool spin-up + page cache
-        decoded0, bytes0 = ds.images_decoded, ds.decoded_bytes
-        t0 = time.perf_counter()
-        for _ in ds.batches(0):
-            pass
-        dt = time.perf_counter() - t0
-        imgs = ds.images_decoded - decoded0
-        dec_mb = (ds.decoded_bytes - bytes0) / 1e6
-        ds.close()
-        img_s = imgs / dt
-        return {
+        pil_s, _, _, _ = rate(paths, backend="pil")
+        block = {
             "image_decode_images_per_sec": round(img_s, 1),
-            "image_decode_mbps_decoded": round(dec_mb / dt, 1),
-            "image_decode_workers": ds.workers,
+            "image_decode_mbps_decoded": round(dec_mbps, 1),
+            "image_decode_workers": pool_w,
+            "image_backend": backend,
             "image_px": size,
             "image_shard_mb": round(shard_mb, 1),
             "image_budget_images_per_sec": _IMAGE_BUDGET_IMG_S,
+            "img_per_sec_pil": round(pil_s, 1),
             # the budget describes the FULL 224px shape; small mode's
             # tiny images would claim a meaningless pass
             **(
@@ -510,6 +538,34 @@ def _image_pipeline_probe(small: bool):
                 else {}
             ),
         }
+        if native:
+            nat_s, _, _, _ = rate(paths, backend="native")
+            nat_u, _, _, _ = rate(paths, backend="native", scaled=False)
+            block.update(
+                {
+                    "img_per_sec_native": round(nat_s, 1),
+                    "img_per_sec_native_unscaled": round(nat_u, 1),
+                    "image_native_vs_pil": round(nat_s / pil_s, 2),
+                }
+            )
+        # hi-res sources: where DCT-scaled decode actually bites
+        hd = os.path.join(d, "hires")
+        hi_paths = pack.pack_synthetic(hd, hi_n, classes, hi_size, 2, seed=1)
+        hi_pil, _, _, _ = rate(hi_paths, backend="pil")
+        block["image_hires_px"] = hi_size
+        block["img_per_sec_pil_hires"] = round(hi_pil, 1)
+        if native:
+            hi_nat, _, _, _ = rate(hi_paths, backend="native")
+            hi_nat_u, _, _, _ = rate(
+                hi_paths, backend="native", scaled=False
+            )
+            block.update(
+                {
+                    "img_per_sec_native_hires": round(hi_nat, 1),
+                    "img_per_sec_native_hires_unscaled": round(hi_nat_u, 1),
+                }
+            )
+        return block
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
@@ -1057,8 +1113,10 @@ def main() -> None:
         if k in extra
     }
     if image_block:
-        # the new decode row rides the headline (acceptance criterion):
-        # delivered img/s + decoded MB/s vs the ResNet input budget
+        # the decode rows ride the headline: delivered img/s vs the
+        # ResNet input budget, plus the per-worker backend pair —
+        # img_per_sec_native appears ONLY when the native backend
+        # actually ran (the driver's acceptance key)
         headline_extra.update(
             {
                 k: image_block[k]
@@ -1066,9 +1124,13 @@ def main() -> None:
                     "image_decode_images_per_sec",
                     "image_decode_mbps_decoded",
                     "image_decode_workers",
+                    "image_backend",
                     "image_px",
                     "image_budget_images_per_sec",
                     "image_meets_budget",
+                    "img_per_sec_pil",
+                    "img_per_sec_native",
+                    "image_native_vs_pil",
                 )
                 if k in image_block
             }
@@ -1087,8 +1149,9 @@ def main() -> None:
     _HEADLINE_MAX = 1800
     for drop in (
         "flash_attn_speedup", "gpt2_decode_tokens_per_sec", "bert_seq_len",
-        "bert_batch_size", "image_px", "image_decode_workers", "bert_mfu",
-        "resnet_mfu",
+        "bert_batch_size", "image_px", "image_decode_workers",
+        "image_native_vs_pil", "img_per_sec_pil", "image_backend",
+        "bert_mfu", "resnet_mfu",
     ):
         if len(line) <= _HEADLINE_MAX:
             break

@@ -129,10 +129,19 @@ def test_vit_trains_from_the_same_image_shards(image_shards):
     # the obs contract on the WIRED path: decode counters AND the
     # staged-batch gauge (fit's prefetcher queue) were exported
     snap = reg.snapshot()
+    from tfk8s_tpu.data.images import image_backend
+
     assert reg.get_counter(
-        "tfk8s_images_decoded_total", {"mode": "train"}
+        "tfk8s_images_decoded_total",
+        {"mode": "train", "backend": image_backend()},
     ) >= 24, snap["counters"]
-    assert "tfk8s_image_decode_queue_depth" in snap["gauges"], snap["gauges"]
+    # mode-labeled gauge: the train series, whatever a concurrent
+    # evaluator would export on its own series
+    assert any(
+        k.startswith("tfk8s_image_decode_queue_depth")
+        and 'mode="train"' in k
+        for k in snap["gauges"]
+    ), snap["gauges"]
 
 
 def test_wrong_format_record_shards_fail_loudly(image_shards, tmp_path):

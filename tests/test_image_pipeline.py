@@ -1,7 +1,13 @@
-"""Image data plane (ISSUE 2 tentpole): schema round-trip, golden
-decode, seed-deterministic augmentation across resume, the worker-pool
-throughput layer (no leaked threads, metrics exported), and the packer
-CLI. The files-backed ResNet e2e lives in tests/test_image_job_e2e.py.
+"""Image data plane (ISSUE 2 tentpole + ISSUE 3 native decode core):
+schema round-trip, golden decode, native-vs-PIL backend agreement,
+seed-deterministic augmentation across resume under BOTH backends, the
+worker-pool throughput layer (no leaked threads, metrics exported), and
+the packer CLI. The files-backed ResNet e2e lives in
+tests/test_image_job_e2e.py.
+
+The native rows SKIP (not error) when the toolchain or jpeglib.h is
+absent — `_native_decode.load()` returns None there and the PIL rows
+still run.
 """
 
 import json
@@ -21,13 +27,26 @@ from tfk8s_tpu.data.images import (
     encode_jpeg,
     encode_png,
     eval_transform,
+    image_backend,
+    image_size,
     set_metrics,
     train_transform,
     write_image_shards,
 )
-from tfk8s_tpu.data.images import pack, schema
-from tfk8s_tpu.data.images.transforms import sample_crop
+from tfk8s_tpu.data.images import _native_decode, pack, schema
+from tfk8s_tpu.data.images.transforms import (
+    choose_scale,
+    eval_crop_box,
+    sample_crop,
+    train_crop_params,
+)
 from tfk8s_tpu.utils.logging import Metrics
+
+needs_native = pytest.mark.skipif(
+    _native_decode.load() is None,
+    reason="native image core unavailable (no g++ or no jpeglib.h) — "
+    "PIL paths still covered",
+)
 
 
 def _checker(h=24, w=32, seed=7):
@@ -111,6 +130,285 @@ class TestDecode:
     def test_undecodable_bytes_raise_typed_error(self):
         with pytest.raises(ImageDecodeError):
             decode_image(b"\xff\xd8\xffgarbage-after-jpeg-magic")
+
+    def test_image_size_prefers_stamped_geometry(self):
+        """A caller that already decoded the Example hands over the
+        header-stamped geometry — no second header parse on the hot
+        path (the bytes are not even looked at)."""
+        assert image_size(b"not parsed at all", stamped=(24, 32, 3)) == (
+            24, 32, 3,
+        )
+        # unstamped (-1) falls back to the real header parse
+        raw = encode_png(_checker())
+        assert image_size(raw, stamped=(-1, -1, -1)) == (24, 32, 3)
+        assert image_size(raw) == (24, 32, 3)
+
+
+class TestNativeBackend:
+    """The libjpeg core (native/imagecore.cc) against the PIL reference:
+    every capability keeps both paths and they must agree — exact pixels
+    where the container is lossless-through-PIL, bounded tolerance for
+    JPEG (IDCT implementations legitimately differ)."""
+
+    def test_backend_resolution_env(self, monkeypatch):
+        monkeypatch.setenv("TFK8S_IMAGE_BACKEND", "pil")
+        assert image_backend() == "pil"
+        monkeypatch.setenv("TFK8S_IMAGE_BACKEND", "bogus")
+        with pytest.raises(ValueError, match="bogus"):
+            image_backend()
+
+    def test_pure_py_forces_pil_everywhere(self, monkeypatch, shards):
+        """TFK8S_PURE_PY=1 is the single switch disabling ALL native
+        codepaths — the new image decoder included, whatever the
+        backend request says."""
+        monkeypatch.setenv("TFK8S_PURE_PY", "1")
+        monkeypatch.setenv("TFK8S_IMAGE_BACKEND", "native")
+        assert _native_decode.load() is None
+        assert image_backend() == "pil"
+        ds = ImageDataset(
+            shards, batch_size=8, image_size=32, backend="native"
+        )
+        try:
+            assert ds.backend == "pil"
+            next(iter(ds.batches(0)))
+            assert ds.native_decoded == 0
+        finally:
+            ds.close()
+
+    def test_involuntary_fallback_warns_once_with_cost(self, monkeypatch,
+                                                       caplog):
+        """Losing the native core without opting out is an
+        input-bandwidth regression — ONE loud line names the measured
+        cost (the recordio '120x' discipline); deliberate opt-outs
+        stay quiet."""
+        import logging
+
+        monkeypatch.setattr(_native_decode, "_tried", True)
+        monkeypatch.setattr(_native_decode, "_lib", None)
+        monkeypatch.setattr(_native_decode, "_fallback_warned", False)
+        with caplog.at_level(logging.WARNING, "tfk8s.data.images.native"):
+            monkeypatch.setenv("TFK8S_IMAGE_BACKEND", "pil")
+            assert image_backend() == "pil"  # deliberate: quiet
+            monkeypatch.setenv("TFK8S_PURE_PY", "1")
+            monkeypatch.setenv("TFK8S_IMAGE_BACKEND", "auto")
+            assert image_backend() == "pil"  # deliberate: quiet
+            assert caplog.records == []
+            monkeypatch.delenv("TFK8S_PURE_PY")
+            assert image_backend() == "pil"  # involuntary: loud, once
+            assert image_backend() == "pil"
+        assert len(caplog.records) == 1
+        assert "slower" in caplog.records[0].getMessage()
+
+    @needs_native
+    def test_png_through_native_backend_pins_exact_pixels(self, monkeypatch):
+        """The native core serves JPEG only; PNG falls through to PIL
+        even under the native backend — bit-exact with the golden."""
+        monkeypatch.setenv("TFK8S_IMAGE_BACKEND", "native")
+        src = _checker()
+        np.testing.assert_array_equal(decode_image(encode_png(src)), src)
+
+    @needs_native
+    def test_jpeg_native_vs_pil_bounded(self):
+        """Same JPEG through both decoders: tolerance, not equality —
+        the IDCTs may legitimately differ by a level or two."""
+        y, x = np.mgrid[0:48, 0:64]
+        src = np.stack([x * 4, y * 5, (x + y) * 3], axis=-1).astype(np.uint8)
+        enc = encode_jpeg(src, quality=95)
+        nat = _native_decode.decode_jpeg(enc)
+        assert nat is not None and nat.shape == (48, 64, 3)
+        from tfk8s_tpu.data.images.decode import open_image
+
+        pil = np.asarray(open_image(enc), np.uint8)
+        assert float(
+            np.mean(np.abs(nat.astype(int) - pil.astype(int)))
+        ) < 2.0
+        assert int(np.max(np.abs(nat.astype(int) - pil.astype(int)))) <= 8
+
+    @needs_native
+    def test_native_rejects_garbage_returns_none(self):
+        assert _native_decode.decode_jpeg(b"\xff\xd8\xffnope") is None
+        assert _native_decode.jpeg_info(b"\xff\xd8\xffnope") is None
+
+    @needs_native
+    def test_scaled_decode_dims_match_libjpeg(self):
+        """img_decode_scaled at scale_num/8 produces exactly
+        ceil(dim * scale_num / 8) per side — the dim contract
+        choose_scale and the scratch sizing rely on."""
+        y, x = np.mgrid[0:57, 0:91]  # deliberately non-multiple-of-8
+        src = np.stack([x, y, x + y], axis=-1).astype(np.uint8)
+        enc = encode_jpeg(src, quality=90)
+        for s in (1, 2, 4, 8):
+            out = _native_decode.decode_jpeg_scaled(enc, s)
+            assert out is not None
+            assert out.shape == (
+                _native_decode.scaled_dim(57, s),
+                _native_decode.scaled_dim(91, s),
+                3,
+            )
+
+    def test_choose_scale_always_covers_crop(self):
+        """The ≥-covers-crop property: whatever the crop/target
+        geometry, the chosen scale's decoded crop is never smaller than
+        the resize target unless even the FULL-scale crop is (upscale
+        case, where only scale 8 is acceptable)."""
+        rng = np.random.default_rng(7)
+        for _ in range(500):
+            h = int(rng.integers(8, 4096))
+            w = int(rng.integers(8, 4096))
+            target = int(rng.integers(8, 512))
+            s = choose_scale(h, w, target)
+            assert s in (1, 2, 4, 8)
+            if h >= target and w >= target:
+                # covers: scaled crop >= target on both sides
+                assert (h * s) // 8 >= target and (w * s) // 8 >= target
+            else:
+                assert s == 8  # can't cover at any scale: decode full
+            if s > 1:
+                # and it is the LARGEST covering downscale among the
+                # SIMD set — the next cheaper one would undershoot
+                prev = {2: 1, 4: 2, 8: 4}[s]
+                assert (h * prev) // 8 < target or (w * prev) // 8 < target
+
+    def test_crop_params_are_backend_independent(self):
+        """The seeded draw consumes geometry only — identical box and
+        flip from the stamped header whichever backend decodes."""
+        a = train_crop_params(np.random.default_rng(3), 375, 500, 0.08)
+        b = train_crop_params(np.random.default_rng(3), 375, 500, 0.08)
+        assert a == b
+        top, left, ch, cw = eval_crop_box(375, 500, 224)
+        assert 0 <= top and top + ch <= 375
+        assert 0 <= left and left + cw <= 500
+        assert ch == cw  # eval view is a centered square
+
+    @needs_native
+    def test_dataset_backends_agree(self, shards):
+        """Same shard set, same seed, both backends: identical labels
+        (crop params are backend-independent) and pixel streams within
+        JPEG-decode tolerance."""
+        for train in (True, False):
+            a = ImageDataset(shards, batch_size=8, image_size=32, seed=5,
+                             train=train, workers=1, backend="pil")
+            b = ImageDataset(shards, batch_size=8, image_size=32, seed=5,
+                             train=train, workers=1, backend="native")
+            try:
+                ba = next(iter(a.batches(0)))
+                bb = next(iter(b.batches(0)))
+                np.testing.assert_array_equal(ba["label"], bb["label"])
+                assert bb["image"].shape == ba["image"].shape
+                # normalized units; ~0.005-0.02 measured with the
+                # support-scaled (antialiased) resample, 0.1 is the
+                # alarm line — a plain 2-tap resample fails it
+                assert float(
+                    np.mean(np.abs(ba["image"] - bb["image"]))
+                ) < 0.1
+                assert b.native_decoded == b.images_decoded
+            finally:
+                a.close()
+                b.close()
+
+    @needs_native
+    def test_resume_replays_identically_under_native(self, shards):
+        """iterator(start_batch=k) equals batch k of an uninterrupted
+        run under the NATIVE backend too — the per-(seed, epoch,
+        record) rng contract survives the backend switch."""
+        ds = ImageDataset(shards, batch_size=8, image_size=32, seed=11,
+                          workers=1, backend="native")
+        it = ds.iterator(prefetch=0)
+        want = [next(it) for _ in range(5)]
+        res = ImageDataset(shards, batch_size=8, image_size=32, seed=11,
+                           workers=1, backend="native")
+        rit = res.iterator(prefetch=0, start_batch=3)
+        try:
+            for k in (3, 4):
+                got = next(rit)
+                np.testing.assert_array_equal(want[k]["image"], got["image"])
+                np.testing.assert_array_equal(want[k]["label"], got["label"])
+        finally:
+            it.close()
+            rit.close()
+            ds.close()
+            res.close()
+
+    @needs_native
+    def test_native_pool_shutdown_leaks_no_threads(self, shards):
+        ds = ImageDataset(shards, batch_size=16, image_size=32, seed=0,
+                          workers=4, backend="native")
+        next(iter(ds.batches(0)))  # spin the pool up
+        assert ds.native_decoded > 0  # the native path actually ran
+        assert any(
+            t.name.startswith("img-decode") for t in threading.enumerate()
+        )
+        ds.close()
+        assert not any(
+            t.name.startswith("img-decode") for t in threading.enumerate()
+        ), [t.name for t in threading.enumerate()]
+
+    def test_lying_stamp_raises_typed_error(self, tmp_path):
+        """A record whose stamped geometry disagrees with the real frame
+        must surface as ImageDecodeError with the record context UNDER
+        EITHER BACKEND — the crop contract is stamp-drawn, so a lying
+        stamp that trained silently under pil but raised under native
+        would break backend interchangeability (and the PIL box error
+        would otherwise escape unwrapped)."""
+        raw = encode_jpeg(_checker())
+        backends = ["pil"] + (
+            ["native"] if _native_decode.load() is not None else []
+        )
+        # both directions lie: overstating overflows the real frame,
+        # UNDERSTATING would silently mis-position every crop (the box
+        # fits inside the larger real frame) — both must raise
+        for lie in ((480, 640, 3), (12, 16, 3)):
+            rec = encode_image_example(raw, label=1, shape=lie)
+            p = str(tmp_path / f"lies-{lie[0]}")
+            paths = write_image_shards([rec for _ in range(8)], p, 1)
+            for backend in backends:
+                ds = ImageDataset(paths, batch_size=8, image_size=8,
+                                  seed=0, workers=1, backend=backend)
+                try:
+                    with pytest.raises(ImageDecodeError, match="disagrees"):
+                        next(iter(ds.batches(0)))
+                finally:
+                    ds.close()
+
+    def test_binder_rejects_wrong_dst(self):
+        """The fused entrypoint validates the pointer handoff — a
+        strided or wrong-dtype destination is an error, not silent
+        pixel corruption."""
+        if _native_decode.load() is None:
+            pytest.skip("native image core unavailable")
+        s = np.asarray([1, 1, 1], np.float32)
+        b = np.zeros(3, np.float32)
+        enc = encode_jpeg(_checker())
+        bad = np.empty((8, 8, 3), np.float64)
+        with pytest.raises(ValueError, match="float32"):
+            _native_decode.decode_rrc_into(
+                enc, (0, 0, 16, 16), 8, False, 8, s, b, bad, (24, 32)
+            )
+        strided = np.empty((8, 16, 3), np.float32)[:, ::2]
+        with pytest.raises(ValueError, match="contiguous"):
+            _native_decode.decode_rrc_into(
+                enc, (0, 0, 16, 16), 8, False, 8, s, b, strided, (24, 32)
+            )
+
+    @needs_native
+    def test_scaled_decode_off_still_agrees(self, shards):
+        """TFK8S_IMAGE_SCALED_DECODE=0 pins full-scale IDCT; output
+        stays within tolerance of the scaled path (same crop, same
+        resample — only the decode resolution differs)."""
+        # target 8 on 40px sources: typical crops choose scale 4/8, so
+        # the pair really compares scaled vs full-scale IDCT
+        a = ImageDataset(shards, batch_size=8, image_size=8, seed=2,
+                         workers=1, backend="native", scaled_decode=True)
+        b = ImageDataset(shards, batch_size=8, image_size=8, seed=2,
+                         workers=1, backend="native", scaled_decode=False)
+        try:
+            ba = next(iter(a.batches(0)))
+            bb = next(iter(b.batches(0)))
+            np.testing.assert_array_equal(ba["label"], bb["label"])
+            assert float(np.mean(np.abs(ba["image"] - bb["image"]))) < 0.3
+        finally:
+            a.close()
+            b.close()
 
 
 class TestTransforms:
@@ -248,14 +546,21 @@ class TestImageDataset:
             ds.close()
             snap = reg.snapshot()
             decoded = reg.get_counter(
-                "tfk8s_images_decoded_total", {"mode": "train"}
+                "tfk8s_images_decoded_total",
+                {"mode": "train", "backend": ds.backend},
             )
             assert decoded is not None and decoded >= 24, snap["counters"]
             assert any(
                 k.startswith("tfk8s_image_decode_seconds")
                 for k in snap["histograms"]
             ), snap["histograms"]
-            assert "tfk8s_image_decode_queue_depth" in snap["gauges"]
+            # the queue gauge is mode-labeled (a concurrent evaluator
+            # owns its own series instead of clobbering this one)
+            assert any(
+                k.startswith("tfk8s_image_decode_queue_depth")
+                and 'mode="train"' in k
+                for k in snap["gauges"]
+            ), snap["gauges"]
             text = reg.prometheus_text()
             assert "tfk8s_images_decoded_total" in text
         finally:

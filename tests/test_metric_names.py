@@ -5,16 +5,21 @@ namespace stays coherent as instrumentation grows."""
 
 import os
 
-from tools.check_metric_names import lint_paths, lint_source
+from tools.check_metric_names import default_paths, lint_paths, lint_source
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_codebase_metric_names_are_coherent():
-    problems = lint_paths(
-        [os.path.join(_ROOT, "tfk8s_tpu"), os.path.join(_ROOT, "tools")]
-    )
+    # default_paths covers the package, tools, and the repo-root bench
+    # script — the full set of sources that register metric names
+    # (including the image data plane's mode/backend-labeled series)
+    problems = lint_paths(default_paths())
     assert problems == [], "\n".join(problems)
+
+
+def test_default_scope_covers_bench():
+    assert any(p.endswith("bench.py") for p in default_paths())
 
 
 def test_lint_catches_bad_names():

@@ -38,10 +38,26 @@ def _cache_dir() -> str:
     return d
 
 
-def _build() -> Optional[str]:
-    src = open(_SRC, "rb").read()
+def build_cached(
+    src_path: str,
+    prefix: str,
+    build_log: logging.Logger,
+    what: str,
+    fallback: str,
+    extra_flags: tuple = (),
+) -> Optional[str]:
+    """The ONE hash-keyed lazy g++ build every native binder shares
+    (recordio here, the image-decode core in
+    ``data/images/_native_decode.py``): compile ``src_path`` into the
+    cache as ``<prefix>-<srchash>.so`` and return its path, or None when
+    the toolchain is absent (quiet — the caller logs the consequence on
+    first use) or the build fails (loud, with the compiler's own words —
+    the silent version of this class of failure cost 120x input
+    bandwidth with empty logs). ``what``/``fallback`` name the core and
+    its degraded path in the warnings."""
+    src = open(src_path, "rb").read()
     tag = hashlib.sha256(src).hexdigest()[:16]
-    out = os.path.join(_cache_dir(), f"recordio-{tag}.so")
+    out = os.path.join(_cache_dir(), f"{prefix}-{tag}.so")
     if os.path.exists(out):
         return out
     # build to a temp name, rename into place: concurrent processes
@@ -49,14 +65,17 @@ def _build() -> Optional[str]:
     # own temp and the last rename wins with identical bytes
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_cache_dir())
     os.close(fd)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", src_path,
+        "-o", tmp, *extra_flags,
+    ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
         return out
     except FileNotFoundError:
         # no toolchain at all — the legitimate quiet-fallback case
-        # (laptops, minimal containers); recordio.py logs the consequence
+        # (laptops, minimal containers)
         try:
             os.unlink(tmp)
         except OSError:
@@ -64,12 +83,11 @@ def _build() -> Optional[str]:
         return None
     except subprocess.CalledProcessError as e:
         # a PRESENT g++ that fails is a broken build, not a missing
-        # toolchain — say so with the compiler's own words (the silent
-        # version of this cost 120x input bandwidth with empty logs)
-        log.warning(
-            "native recordio build FAILED (g++ rc=%s); falling back to the "
-            "pure-Python codec (~120x slower reads). stderr:\n%s",
-            e.returncode,
+        # toolchain
+        build_log.warning(
+            "native %s build FAILED (g++ rc=%s); falling back to %s. "
+            "stderr:\n%s",
+            what, e.returncode, fallback,
             (e.stderr or b"").decode(errors="replace")[-2000:],
         )
         try:
@@ -78,15 +96,22 @@ def _build() -> Optional[str]:
             pass
         return None
     except (subprocess.SubprocessError, OSError) as e:
-        log.warning(
-            "native recordio build errored (%s: %s); falling back to the "
-            "pure-Python codec (~120x slower reads)", type(e).__name__, e,
+        build_log.warning(
+            "native %s build errored (%s: %s); falling back to %s",
+            what, type(e).__name__, e, fallback,
         )
         try:
             os.unlink(tmp)
         except OSError:
             pass
         return None
+
+
+def _build() -> Optional[str]:
+    return build_cached(
+        _SRC, "recordio", log, "recordio core",
+        "the pure-Python codec (~120x slower reads)",
+    )
 
 
 def load() -> Optional[ctypes.CDLL]:

@@ -165,6 +165,11 @@ class RecordDataset:
             [int(g) for g in take],
             epoch,
         )
+        if isinstance(examples, dict):
+            # the decode stage assembled the batch itself (images
+            # pipeline: workers write a preallocated [B, ...] batch in
+            # place — stacking again would re-copy the whole batch)
+            return examples
         keys = examples[0].keys()
         for ex in examples[1:]:
             if ex.keys() != keys:
@@ -200,7 +205,11 @@ class RecordDataset:
         over a worker pool). ``record_ids`` are the dataset-global
         record indices and ``epoch`` the shuffle epoch — together the
         position-independent identity a subclass needs to seed
-        per-record augmentation deterministically across resume."""
+        per-record augmentation deterministically across resume.
+
+        A subclass may instead return the ASSEMBLED batch (a dict of
+        stacked arrays) and ``_load`` passes it through untouched —
+        the preallocated-batch fast path (one less full-batch copy)."""
         return [self.decode(r) for r in records]
 
     def close(self) -> None:

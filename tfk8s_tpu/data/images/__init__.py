@@ -8,9 +8,12 @@ trained on synthetic template-plus-noise tensors):
 - ``schema``   — the image Example layout (encoded JPEG/PNG bytes +
   label + shape metadata) on the ``data/example.py`` codec, plus the
   shard writer that packs ImageNet-style trees into recordio shards;
-- ``decode``   — compressed bytes -> HWC uint8 RGB (PIL-backed; the
-  ``native/recordio.cc`` g++ lazy-build pattern is the designated fast
-  path when a libjpeg-turbo core lands);
+- ``decode``   — compressed bytes -> HWC uint8 RGB behind a backend
+  dispatch (``TFK8S_IMAGE_BACKEND=native|pil|auto``): the native
+  libjpeg core (``native/imagecore.cc``, lazy-built by
+  ``_native_decode.py`` on the recordio.cc g++ pattern) serves JPEG
+  with DCT-scaled decode; PIL is the reference path and the fallback
+  when the toolchain, libjpeg, or the format support is absent;
 - ``transforms`` — random-resized-crop / horizontal-flip / per-channel
   normalize for training, resize + center-crop for eval, all
   seed-deterministic for resume;
@@ -28,6 +31,8 @@ from tfk8s_tpu.data.images.decode import (  # noqa: F401
     decode_image,
     encode_jpeg,
     encode_png,
+    image_backend,
+    image_size,
 )
 from tfk8s_tpu.data.images.pipeline import (  # noqa: F401
     ImageDataset,
@@ -63,6 +68,8 @@ __all__ = [
     "encode_png",
     "eval_transform",
     "get_metrics",
+    "image_backend",
+    "image_size",
     "is_image_example",
     "set_metrics",
     "train_transform",

@@ -771,11 +771,14 @@ class Trainer:
                 ">= one file per host to restore per-host file IO)",
                 task.name, len(ds.files), nproc,
             )
+        backend = getattr(ds, "backend", None)  # image decode backend
         log.info(
-            "%s: %s file input (%s-sharded) — process %d/%d reads %d files "
-            "/ %d records, %d rows/step, resuming at batch %d",
-            task.name, cfg.input_format, ds.shard_by, jax.process_index(),
-            nproc, len(ds.files), len(ds), local_rows, start_step,
+            "%s: %s file input (%s-sharded%s) — process %d/%d reads %d "
+            "files / %d records, %d rows/step, resuming at batch %d",
+            task.name, cfg.input_format, ds.shard_by,
+            f", {backend} decode" if backend else "",
+            jax.process_index(), nproc, len(ds.files), len(ds), local_rows,
+            start_step,
         )
         # prefetch=0: fit's own _BatchPrefetcher supplies the background
         # thread; a second producer here would double-buffer the batches
@@ -1168,9 +1171,12 @@ class Trainer:
 
                                 im = _img_metrics()
                                 if im is not None:
+                                    # mode-labeled: a concurrent
+                                    # evaluator owns its own series
                                     im.set_gauge(
                                         "tfk8s_image_decode_queue_depth",
                                         float(prefetcher.depth()),
+                                        labels={"mode": "train"},
                                     )
                     progress.report(**report_kw)
                     log.info(

@@ -79,6 +79,9 @@ _TRAINING_GAUGE_KEYS = (
     "input_mb_per_sec",
     "input_wait_seconds",
     "input_starved_steps",
+    # the decode pool's delivered rate (image input) — the series the
+    # image-input-ceiling operator alert watches, next to input MB/s
+    "decoded_images_per_sec",
 )
 
 # Node-lost detection (k8s node-lease semantics): a RUNNING pod whose

@@ -94,12 +94,24 @@ def lint_paths(paths: List[str]) -> List[str]:
     return problems
 
 
-def main(argv: List[str]) -> int:
+def default_paths() -> List[str]:
+    """The lint scope tier-1 enforces (tests/test_metric_names.py uses
+    the same list): every package source plus the repo-root scripts
+    that register metrics — the image data plane's labeled decode
+    series (``tfk8s_images_decoded_total{mode, backend}``,
+    ``tfk8s_image_decode_queue_depth{mode}``, ...) lint through the
+    ``tfk8s_tpu`` scan; labels are series identity, so only the NAMES
+    are in scope here."""
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = argv or [
+    return [
         os.path.join(here, "tfk8s_tpu"),
         os.path.join(here, "tools"),
+        os.path.join(here, "bench.py"),
     ]
+
+
+def main(argv: List[str]) -> int:
+    paths = argv or default_paths()
     problems = lint_paths(paths)
     for p in problems:
         print(p)
