@@ -1094,6 +1094,21 @@ def main() -> None:
         print(f"bench: could not write {detail_name}: {exc}", file=sys.stderr)
         detail_name = None
 
+    print(build_headline(detail, image_block, detail_name))
+
+
+# The driver-artifact contract (VERDICT r5 next #1), enforced by the
+# tier-1 test tests/test_bench_headline.py: ONE JSON line, at most this
+# many characters — round 5's full record outgrew the driver's 2,000-char
+# tail capture and the archived artifact lost its headline keys entirely.
+HEADLINE_MAX_CHARS = 1800
+
+
+def build_headline(detail: dict, image_block, detail_name) -> str:
+    """Assemble the final-stdout headline line from the full detail
+    record: the fixed key set, the image-decode rows when present, and a
+    graceful degrade order that drops optional keys until the line fits
+    HEADLINE_MAX_CHARS — the ceiling holds even if a future key grows."""
     extra = detail["extra"]
     headline_extra = {
         k: extra[k]
@@ -1144,20 +1159,20 @@ def main() -> None:
         "extra": headline_extra,
     }
     line = json.dumps(headline)
-    # hard ceiling with a graceful degrade order — never exceed the
-    # contract even if a future key grows
-    _HEADLINE_MAX = 1800
     for drop in (
         "flash_attn_speedup", "gpt2_decode_tokens_per_sec", "bert_seq_len",
         "bert_batch_size", "image_px", "image_decode_workers",
         "image_native_vs_pil", "img_per_sec_pil", "image_backend",
         "bert_mfu", "resnet_mfu",
+        "image_decode_mbps_decoded", "image_budget_images_per_sec",
+        "image_meets_budget", "img_per_sec_native",
+        "image_decode_images_per_sec", "bert_base_mlm_step_time_ms",
     ):
-        if len(line) <= _HEADLINE_MAX:
+        if len(line) <= HEADLINE_MAX_CHARS:
             break
         headline["extra"].pop(drop, None)
         line = json.dumps(headline)
-    print(line)
+    return line
 
 
 if __name__ == "__main__":

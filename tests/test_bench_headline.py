@@ -1,0 +1,90 @@
+"""The bench→driver artifact contract (ROADMAP 'Bench→driver artifact
+contract'): bench.py's FINAL stdout line must be ONE compact JSON line of
+at most bench.HEADLINE_MAX_CHARS characters — round 5's record was lost
+to tail truncation when the detail outgrew the driver's capture. The
+contract was previously enforced only by convention; this pins it in
+tier-1 against the real headline builder, including the graceful degrade
+order under a deliberately bloated detail record.
+"""
+
+import json
+
+import bench
+
+
+def _detail(extra):
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": 1234.56,
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0123,
+        "extra": extra,
+    }
+
+
+FULL_EXTRA = {
+    "bert_base_mlm_step_time_ms": 41.123,
+    "resnet_mfu": 0.1234,
+    "bert_mfu": 0.2345,
+    "resnet_batch_size": 256,
+    "bert_batch_size": 64,
+    "bert_seq_len": 128,
+    "n_chips": 1,
+    "gpt2_decode_tokens_per_sec": 6789.1,
+    "flash_attn_speedup": 1.234,
+    "degraded_sections": ["flash_8k", "bert2k"],
+    "baseline_config_mismatch": True,
+    # keys NOT in the headline allowlist must never leak into the line
+    "control_plane": {"reconcile": {"jobs_per_s_to_running": 93.9}},
+    "noise": {"resnet_step_windows_ms": [1.0] * 50},
+}
+
+FULL_IMAGE_BLOCK = {
+    "image_decode_images_per_sec": 1030.1,
+    "image_decode_mbps_decoded": 610.2,
+    "image_decode_workers": 1,
+    "image_backend": "native",
+    "image_px": 224,
+    "image_budget_images_per_sec": 2447,
+    "image_meets_budget": False,
+    "img_per_sec_pil": 440.0,
+    "img_per_sec_native": 1030.1,
+    "image_native_vs_pil": 2.34,
+}
+
+
+def test_headline_is_one_json_line_under_the_ceiling():
+    line = bench.build_headline(
+        _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json"
+    )
+    assert "\n" not in line
+    assert len(line) <= bench.HEADLINE_MAX_CHARS
+    parsed = json.loads(line)
+    assert parsed["metric"] == "resnet50_images_per_sec_per_chip"
+    assert parsed["detail"] == "BENCH_DETAIL_test.json"
+    # detail-only blocks never ride the headline
+    assert "control_plane" not in parsed["extra"]
+    assert "noise" not in parsed["extra"]
+    # the driver's acceptance keys survive at normal sizes
+    assert parsed["extra"]["img_per_sec_native"] == 1030.1
+
+
+def test_headline_degrades_instead_of_exceeding_ceiling():
+    """Even a pathologically bloated (but allowlisted) record must fit:
+    the degrade order keeps dropping optional keys until the line does."""
+    fat = dict(FULL_EXTRA)
+    fat["degraded_sections"] = [f"section_{i:03d}" for i in range(60)]
+    line = bench.build_headline(_detail(fat), FULL_IMAGE_BLOCK, None)
+    assert "\n" not in line
+    assert len(line) <= bench.HEADLINE_MAX_CHARS
+    parsed = json.loads(line)
+    # the invariant headline keys are never dropped
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in parsed
+
+
+def test_headline_without_image_block():
+    line = bench.build_headline(_detail(dict(FULL_EXTRA)), None, None)
+    parsed = json.loads(line)
+    assert "image_backend" not in parsed["extra"]
+    assert len(line) <= bench.HEADLINE_MAX_CHARS

@@ -1,0 +1,329 @@
+"""Control-plane hot-path behavior (ISSUE 4): bounded watch queues with
+slow-watcher coalescing, batched reflector delta coalescing, the status
+deep-compare write skip, batched gang pod creation under one rate-limiter
+acquire, and the kubelet's stop-aware status-retry wait.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tfk8s_tpu.api import ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec
+from tfk8s_tpu.api.types import Pod, TPUSpec
+from tfk8s_tpu.client import ClusterStore, EventType, FakeClientset
+from tfk8s_tpu.client.ratelimit import TokenBucketRateLimiter
+from tfk8s_tpu.client.store import Watch, WatchEvent, _coalesce_type
+from tfk8s_tpu.utils.logging import Metrics
+
+
+def job(name="j", ns="default"):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=2, template=ContainerSpec(entrypoint="e")
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+        ),
+    )
+
+
+# --- watch queue: bound + coalescing ----------------------------------------
+
+
+def test_slow_watcher_backlog_bounded_and_converges():
+    s = ClusterStore()
+    s.create(job("fan"))
+    w = s.watch("TPUJob", queue_limit=4)
+    from tfk8s_tpu.api.frozen import thaw
+
+    cur = thaw(s.get("TPUJob", "default", "fan"))
+    for _ in range(100):
+        cur.status.gang_restarts += 1
+        cur = s.update_status(cur)
+    # backlog stayed bounded: same-key events merged, latest state wins
+    assert len(w._items) <= 4
+    assert w.coalesced_total >= 96
+    last = None
+    while True:
+        ev = w.next(timeout=0.05)
+        if ev is None:
+            break
+        last = ev
+    assert last is not None
+    assert last.object.status.gang_restarts == 100  # converged to final
+    s.stop_watch(w)
+
+
+def test_fast_watcher_under_bound_never_coalesces():
+    s = ClusterStore()
+    w = s.watch("TPUJob")  # default (large) bound
+    s.create(job("a"))
+    s.create(job("b"))
+    got = [w.next(timeout=1) for _ in range(2)]
+    assert [ev.object.metadata.name for ev in got] == ["a", "b"]
+    assert w.coalesced_total == 0
+    s.stop_watch(w)
+
+
+def test_coalesce_type_merge_rules():
+    A, M, D = EventType.ADDED, EventType.MODIFIED, EventType.DELETED
+    assert _coalesce_type(A, M) == A  # unseen add absorbs updates
+    assert _coalesce_type(M, M) == M
+    assert _coalesce_type(A, D) == D  # delete always wins
+    assert _coalesce_type(M, D) == D
+
+
+def test_pending_delete_is_a_coalescing_barrier():
+    """A backlogged watcher must still observe delete+recreate as TWO
+    events: collapsing them would hide the deletion (and the uid change)
+    from consumers whose delete path does real work (the kubelet stops
+    the old pod's runner on delete)."""
+    w = Watch(queue_limit=1)
+    w._push(WatchEvent(EventType.DELETED, job("x")))
+    recreated = job("x")
+    recreated.metadata.uid = "fresh"
+    assert w._push(WatchEvent(EventType.ADDED, recreated)) is False  # no merge
+    first = w.next(timeout=0.1)
+    second = w.next(timeout=0.1)
+    assert first.type == EventType.DELETED
+    assert second.type == EventType.ADDED
+    assert second.object.metadata.uid == "fresh"
+    # ...while a further update DOES coalesce into the pending re-ADD
+    w._push(WatchEvent(EventType.DELETED, job("y")))
+    w._push(WatchEvent(EventType.ADDED, job("y")))
+    assert w._push(WatchEvent(EventType.MODIFIED, job("y"))) is True
+
+
+def test_coalesced_events_export_store_metric():
+    m = Metrics()
+    s = ClusterStore(metrics=m)
+    s.create(job("fan"))
+    w = s.watch("TPUJob", queue_limit=2)
+    from tfk8s_tpu.api.frozen import thaw
+
+    cur = thaw(s.get("TPUJob", "default", "fan"))
+    for _ in range(10):
+        cur.status.gang_restarts += 1
+        cur = s.update_status(cur)
+    assert (
+        m.get_counter("tfk8s_watch_coalesced_total", {"kind": "TPUJob"}) or 0
+    ) >= 8
+    s.stop_watch(w)
+
+
+def test_next_batch_drains_a_burst():
+    w = Watch()
+    for i in range(5):
+        w._push(WatchEvent(EventType.MODIFIED, job(f"j{i}")))
+    evs = w.next_batch(max_items=3, timeout=0.1)
+    assert len(evs) == 3
+    evs += w.next_batch(max_items=10, timeout=0.1)
+    assert len(evs) == 5
+    assert w.next_batch(max_items=10, timeout=0.02) == []
+
+
+# --- informer: per-key delta coalescing -------------------------------------
+
+
+def test_informer_batch_coalesces_same_key_updates():
+    from tfk8s_tpu.client import ResourceEventHandler, SharedIndexInformer
+
+    cs = FakeClientset()
+    m = Metrics()
+    inf = SharedIndexInformer(cs.tpujobs(namespace=None), name="t", metrics=m)
+    calls = []
+    inf.add_event_handler(
+        ResourceEventHandler(
+            on_add=lambda o: calls.append(("add", o.metadata.name)),
+            on_update=lambda o, n: calls.append(("upd", n.metadata.name)),
+            on_delete=lambda o: calls.append(("del", o.metadata.name)),
+        )
+    )
+    j1, j2, j3 = job("x"), job("x"), job("x")
+    j1.status.gang_restarts, j2.status.gang_restarts, j3.status.gang_restarts = 1, 2, 3
+    other = job("y")
+    inf._handle_batch(
+        [
+            WatchEvent(EventType.ADDED, j1),
+            WatchEvent(EventType.MODIFIED, j2),
+            WatchEvent(EventType.ADDED, other),
+            WatchEvent(EventType.MODIFIED, j3),
+        ]
+    )
+    # three events for default/x collapsed into ONE dispatch (an add,
+    # since the cache never saw x before) carrying the LAST state
+    assert calls == [("add", "y"), ("add", "x")]
+    assert inf.indexer.get_by_key("default/x").status.gang_restarts == 3
+    assert (
+        m.get_counter("informer.coalesced_deltas_total", {"informer": "t"})
+        == 2.0
+    )
+
+
+def test_informer_batch_delete_wins():
+    from tfk8s_tpu.client import SharedIndexInformer
+
+    cs = FakeClientset()
+    inf = SharedIndexInformer(cs.tpujobs(namespace=None), name="t")
+    inf._handle_batch(
+        [
+            WatchEvent(EventType.ADDED, job("x")),
+            WatchEvent(EventType.DELETED, job("x")),
+        ]
+    )
+    assert inf.indexer.get_by_key("default/x") is None
+
+
+def test_informer_batch_never_drops_delete_of_a_recreate():
+    """delete+recreate inside one drained batch must dispatch BOTH: the
+    kubelet's on_delete stops the old pod's runner — swallowing the
+    delete would leave two trainers running on one slice."""
+    from tfk8s_tpu.client import ResourceEventHandler, SharedIndexInformer
+
+    cs = FakeClientset()
+    inf = SharedIndexInformer(cs.tpujobs(namespace=None), name="t")
+    calls = []
+    inf.add_event_handler(
+        ResourceEventHandler(
+            on_add=lambda o: calls.append(("add", o.metadata.uid)),
+            on_update=lambda o, n: calls.append(("upd", n.metadata.uid)),
+            on_delete=lambda o: calls.append(("del", o.metadata.uid)),
+        )
+    )
+    old = job("x")
+    old.metadata.uid = "old"
+    new = job("x")
+    new.metadata.uid = "new"
+    newer = job("x")
+    newer.metadata.uid = "new"
+    newer.status.gang_restarts = 1
+    inf._handle_batch(
+        [
+            WatchEvent(EventType.ADDED, old),
+            WatchEvent(EventType.DELETED, old),
+            WatchEvent(EventType.ADDED, new),
+            WatchEvent(EventType.MODIFIED, newer),
+        ]
+    )
+    # the delete survives; the post-delete add+modify coalesce into one
+    # dispatch carrying the final state
+    assert ("del", "old") in calls
+    assert calls[-1] == ("add", "new")
+    assert inf.indexer.get_by_key("default/x").status.gang_restarts == 1
+
+
+# --- rate limiter: one batched acquire --------------------------------------
+
+
+def test_accept_n_is_one_batched_wait():
+    t = [0.0]
+    sleeps = []
+
+    def clock():
+        return t[0]
+
+    def sleep(d):
+        sleeps.append(d)
+        t[0] += d
+
+    rl = TokenBucketRateLimiter(qps=10, burst=2, clock=clock, sleep=sleep)
+    rl.accept(5)  # 2 banked + 3 owed -> ONE 0.3s sleep
+    assert sleeps == [pytest.approx(0.3)]
+    # the debt queues later callers at the overall rate
+    rl.accept()
+    assert t[0] == pytest.approx(0.4)
+
+
+def test_create_many_single_acquire_and_already_exists_skip():
+    calls = []
+
+    class RecordingLimiter:
+        def accept(self, n=1):
+            calls.append(n)
+
+    from tfk8s_tpu.client.clientset import TypedClient
+
+    store = ClusterStore()
+    c = TypedClient(store, "TPUJob", "default", RecordingLimiter())
+    c.create(job("pre"))
+    created = c.create_many([job("pre"), job("a"), job("b")])
+    assert calls == [1, 3]  # one batched acquire for the gang
+    assert [o.metadata.name for o in created] == ["a", "b"]  # pre skipped
+    assert {o.metadata.name for o in store.list("TPUJob")[0]} == {
+        "pre", "a", "b",
+    }
+
+
+def test_fake_create_many_records_per_object_actions():
+    cs = FakeClientset()
+    cs.pods().create_many(
+        [Pod(metadata=ObjectMeta(name=f"p{i}")) for i in range(3)]
+    )
+    assert [a.verb for a in cs.actions(kind="Pod")] == ["create"] * 3
+
+
+# --- controller: status deep-compare skip -----------------------------------
+
+
+def test_write_status_skips_unchanged_and_counts():
+    from tfk8s_tpu.api import serde
+    from tfk8s_tpu.trainer.tpujob_controller import TPUJobController
+
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs)
+    created = cs.tpujobs().create(job("skipme"))
+    j = serde.roundtrip(created)
+    j._status_baseline = serde.to_wire(created.status)
+    cs.clear_actions()
+    assert ctrl._write_status(j) is True
+    assert cs.actions(verb="patch_status") == []  # no round trip
+    assert (
+        ctrl.metrics.get_counter("tfk8s_status_patches_skipped_total") == 1.0
+    )
+    # a real change writes (and refreshes the baseline for the next call)
+    j.status.gang_restarts = 2
+    assert ctrl._write_status(j) is True
+    assert len(cs.actions(verb="patch_status")) == 1
+    assert ctrl._write_status(j) is True  # identical again -> skipped
+    assert len(cs.actions(verb="patch_status")) == 1
+    assert (
+        ctrl.metrics.get_counter("tfk8s_status_patches_skipped_total") == 2.0
+    )
+
+
+# --- kubelet: stop-aware status-retry wait ----------------------------------
+
+
+def test_kubelet_outage_retry_stops_promptly():
+    from tfk8s_tpu.api.types import PodPhase
+    from tfk8s_tpu.client.store import Unavailable
+    from tfk8s_tpu.runtime.kubelet import LocalKubelet
+
+    cs = FakeClientset()
+
+    def outage(action, obj):
+        raise Unavailable("injected outage")
+
+    cs.prepend_reactor("get", "Pod", outage)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet._stop = stop
+    result = {}
+
+    def write():
+        result["ok"] = kubelet._set_phase("default/p", "uid", PodPhase.RUNNING)
+
+    t = threading.Thread(target=write, daemon=True)
+    t0 = time.monotonic()
+    t.start()
+    time.sleep(0.15)  # land inside the 1.0s retry wait
+    stop.set()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+    # the stop interrupted the wait instead of riding out the full second
+    assert time.monotonic() - t0 < 1.0
+    assert result["ok"] is False

@@ -22,6 +22,7 @@ from tfk8s_tpu.api.types import (
     ContainerSpec, Lease, LeaseSpec, ObjectMeta, ReplicaSpec, ReplicaType,
     RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
 )
+from tfk8s_tpu.api.frozen import thaw
 from tfk8s_tpu.client.store import (
     ClusterStore, EventType, Gone, JournalCorrupt, StoreError,
 )
@@ -82,7 +83,8 @@ class TestJournalRoundTrip:
         s.close()
 
         r = ClusterStore(journal_dir=d, fsync=False)
-        got = r.get("TPUJob", "default", "gated")
+        # store reads are shared frozen instances: thaw to edit
+        got = thaw(r.get("TPUJob", "default", "gated"))
         assert got.metadata.deletion_timestamp is not None
         assert got.metadata.finalizers == ["tfk8s.dev/teardown"]
         # stripping the finalizer after restart completes the delete

@@ -22,6 +22,35 @@ def test_default_scope_covers_bench():
     assert any(p.endswith("bench.py") for p in default_paths())
 
 
+def test_default_scope_covers_hotpath_counters():
+    """The ISSUE-4 control-plane counters must stay inside the linted
+    scope under their exact exported names — dashboards and the bench's
+    reconcile arm key off them (a silent rename would pass lint but break
+    both)."""
+    wanted = {
+        "tfk8s_watch_coalesced_total": False,
+        "tfk8s_status_patches_skipped_total": False,
+    }
+    for root in default_paths():
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = [
+                os.path.join(dirpath, n)
+                for dirpath, _dirs, names in os.walk(root)
+                for n in names
+                if n.endswith(".py")
+            ]
+        for path in files:
+            with open(path) as f:
+                src = f.read()
+            for name in wanted:
+                if f'"{name}"' in src:
+                    wanted[name] = True
+    missing = [n for n, seen in wanted.items() if not seen]
+    assert not missing, f"hot-path counters not registered in lint scope: {missing}"
+
+
 def test_lint_catches_bad_names():
     src = "\n".join(
         [

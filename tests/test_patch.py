@@ -31,6 +31,7 @@ from tfk8s_tpu.api.types import (
     RunPolicy, SchedulingPolicy, TPUJob, TPUJobSpec, TPUSpec,
 )
 from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.frozen import thaw
 from tfk8s_tpu.client import FakeClientset
 from tfk8s_tpu.client.apiserver import APIServer
 from tfk8s_tpu.client.store import (
@@ -108,7 +109,9 @@ class TestStorePatch:
     def test_object_patch_cannot_touch_status(self):
         s = ClusterStore()
         s.create(make_job("j"))
-        got = s.get("TPUJob", "default", "j")
+        # store reads are shared frozen instances (copy-on-write): thaw
+        # before the read-modify-write
+        got = thaw(s.get("TPUJob", "default", "j"))
         helpers.set_condition(got.status, JobConditionType.RUNNING, reason="r")
         s.update_status(got)
         out = s.patch(
@@ -439,7 +442,7 @@ class TestStatusPatchEdgeCases:
         deletion semantics) — a None status would crash every reader."""
         s = ClusterStore()
         s.create(make_job("j"))
-        got = s.get("TPUJob", "default", "j")
+        got = thaw(s.get("TPUJob", "default", "j"))
         helpers.set_condition(got.status, JobConditionType.RUNNING, reason="r")
         s.update_status(got)
         out = s.patch(

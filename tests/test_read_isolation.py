@@ -1,0 +1,241 @@
+"""Read-isolation tests for the copy-on-write control plane (ISSUE 4).
+
+The store/informer hot path shares ONE frozen instance per object across
+every reader (store get/list, watch events, the informer cache, listers).
+These tests pin the correctness cliff of that design:
+
+- no consumer mutation can ever reach the store (frozen path: the
+  mutation RAISES the typed FrozenObjectError; old-style mutable path —
+  the typed client's thaw-on-get boundary and the remote client's fresh
+  decodes — the mutation lands on a private copy and the server state is
+  provably unaffected);
+- delivered watch events and lister results can never alias-corrupt the
+  store;
+- write verbs still return private mutable copies (the pre-existing
+  read-modify-write contract).
+"""
+
+import threading
+
+import pytest
+
+from tfk8s_tpu.api import ContainerSpec, ObjectMeta, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec
+from tfk8s_tpu.api.frozen import FrozenObjectError, is_frozen, thaw
+from tfk8s_tpu.client import ClusterStore, FakeClientset, SharedIndexInformer, wait_for_cache_sync
+from tfk8s_tpu.client.listers import Lister
+
+
+def job(name="iso", ns="default", labels=None):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=dict(labels or {})),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=1, template=ContainerSpec(entrypoint="e")
+                )
+            }
+        ),
+    )
+
+
+# --- store-level isolation (frozen path) ------------------------------------
+
+
+def test_store_get_returns_shared_frozen_instance():
+    s = ClusterStore()
+    s.create(job())
+    a = s.get("TPUJob", "default", "iso")
+    b = s.get("TPUJob", "default", "iso")
+    assert a is b  # zero-copy shared read
+    assert is_frozen(a)
+
+
+def test_frozen_get_mutation_raises_and_store_unaffected():
+    s = ClusterStore()
+    s.create(job(labels={"team": "x"}))
+    got = s.get("TPUJob", "default", "iso")
+    with pytest.raises(FrozenObjectError):
+        got.metadata.name = "evil"
+    with pytest.raises(FrozenObjectError):
+        got.metadata.labels["team"] = "evil"
+    with pytest.raises(FrozenObjectError):
+        got.metadata.finalizers.append("evil")
+    with pytest.raises(FrozenObjectError):
+        got.status.conditions.append(object())
+    with pytest.raises(FrozenObjectError):
+        got.spec.replica_specs[ReplicaType.WORKER].replicas = 99
+    fresh = s.get("TPUJob", "default", "iso")
+    assert fresh.metadata.name == "iso"
+    assert fresh.metadata.labels == {"team": "x"}
+    assert fresh.metadata.finalizers == []
+    assert fresh.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+
+
+def test_list_filters_before_any_copy_and_shares_instances():
+    s = ClusterStore()
+    s.create(job("a", labels={"pick": "1"}))
+    s.create(job("b"))
+    items, _rv = s.list("TPUJob", "default", {"pick": "1"})
+    assert [o.metadata.name for o in items] == ["a"]
+    assert items[0] is s.get("TPUJob", "default", "a")
+    with pytest.raises(FrozenObjectError):
+        items[0].metadata.labels["pick"] = "2"
+
+
+def test_watch_event_mutation_raises_and_store_unaffected():
+    s = ClusterStore()
+    w = s.watch("TPUJob")
+    s.create(job())
+    ev = w.next(timeout=1)
+    assert is_frozen(ev.object)
+    with pytest.raises(FrozenObjectError):
+        ev.object.status.gang_restarts = 99
+    with pytest.raises(FrozenObjectError):
+        ev.object.metadata.labels["x"] = "y"
+    assert s.get("TPUJob", "default", "iso").status.gang_restarts == 0
+    s.stop_watch(w)
+
+
+def test_watchers_share_one_frozen_event_object():
+    s = ClusterStore()
+    w1, w2 = s.watch("TPUJob"), s.watch("TPUJob")
+    s.create(job())
+    e1, e2 = w1.next(timeout=1), w2.next(timeout=1)
+    assert e1.object is e2.object  # shared fanout, no per-watcher copy
+    s.stop_watch(w1)
+    s.stop_watch(w2)
+
+
+def test_thaw_gives_independent_mutable_copy():
+    s = ClusterStore()
+    s.create(job(labels={"a": "1"}))
+    mine = thaw(s.get("TPUJob", "default", "iso"))
+    mine.metadata.labels["a"] = "2"
+    mine.status.gang_restarts = 7
+    cur = s.get("TPUJob", "default", "iso")
+    assert cur.metadata.labels == {"a": "1"}
+    assert cur.status.gang_restarts == 0
+
+
+def test_write_verbs_return_private_mutable_copies():
+    s = ClusterStore()
+    created = s.create(job())
+    created.metadata.labels["w"] = "1"  # must not raise
+    created.spec.replica_specs[ReplicaType.WORKER].replicas = 2
+    updated = s.update(created)
+    updated.status.gang_restarts = 3  # must not raise
+    assert s.get("TPUJob", "default", "iso").status.gang_restarts == 0
+    assert (
+        s.get("TPUJob", "default", "iso")
+        .spec.replica_specs[ReplicaType.WORKER]
+        .replicas
+        == 2
+    )
+
+
+def test_journal_restored_objects_are_frozen(tmp_path):
+    d = str(tmp_path / "j")
+    s = ClusterStore(journal_dir=d, fsync=False)
+    s.create(job())
+    s.close()
+    r = ClusterStore(journal_dir=d, fsync=False)
+    got = r.get("TPUJob", "default", "iso")
+    assert is_frozen(got)
+    with pytest.raises(FrozenObjectError):
+        got.metadata.name = "evil"
+
+
+# --- typed-client boundary (old-style mutable path) -------------------------
+
+
+def test_typed_client_get_is_copy_on_read():
+    """The documented mutable path: TypedClient.get thaws, so mutating
+    clients (kubelet read-modify-write) keep working and the store is
+    provably unaffected."""
+    cs = FakeClientset()
+    cs.tpujobs().create(job())
+    mine = cs.tpujobs().get("iso")
+    mine.status.gang_restarts = 9  # old-style mutation: no raise
+    mine.metadata.labels["x"] = "y"
+    cur = cs.store.get("TPUJob", "default", "iso")
+    assert cur.status.gang_restarts == 0
+    assert "x" not in cur.metadata.labels
+
+
+def test_typed_client_list_shares_frozen_instances():
+    cs = FakeClientset()
+    cs.tpujobs().create(job())
+    items, _ = cs.tpujobs().list()
+    assert is_frozen(items[0])
+    with pytest.raises(FrozenObjectError):
+        items[0].metadata.labels["x"] = "y"
+
+
+# --- informer cache / lister isolation --------------------------------------
+
+
+def _synced_informer(cs):
+    inf = SharedIndexInformer(cs.tpujobs(namespace=None), name="iso")
+    stop = threading.Event()
+    inf.run(stop)
+    assert wait_for_cache_sync(stop, inf, timeout=5)
+    return inf, stop
+
+
+def test_lister_results_cannot_alias_corrupt_the_cache_or_store():
+    cs = FakeClientset()
+    cs.tpujobs().create(job(labels={"keep": "1"}))
+    inf, stop = _synced_informer(cs)
+    lister = Lister(inf.indexer, "TPUJob")
+    got = lister.get("default", "iso")
+    assert is_frozen(got)
+    with pytest.raises(FrozenObjectError):
+        got.metadata.labels["keep"] = "evil"
+    with pytest.raises(FrozenObjectError):
+        got.status.gang_restarts = 5
+    # cache AND store unaffected
+    assert lister.get("default", "iso").metadata.labels == {"keep": "1"}
+    assert cs.store.get("TPUJob", "default", "iso").metadata.labels == {
+        "keep": "1"
+    }
+    # zero-copy: repeated cache reads share the instance
+    assert lister.get("default", "iso") is lister.get("default", "iso")
+    stop.set()
+    inf.join(2)
+
+
+def test_handler_delivered_objects_are_frozen():
+    from tfk8s_tpu.client import ResourceEventHandler
+
+    cs = FakeClientset()
+    inf, stop = _synced_informer(cs)
+    seen = []
+    inf.add_event_handler(ResourceEventHandler(on_add=seen.append))
+    cs.tpujobs().create(job("live"))
+    pause = threading.Event()
+    for _ in range(500):
+        if seen:
+            break
+        pause.wait(0.01)
+    assert seen and is_frozen(seen[0])
+    with pytest.raises(FrozenObjectError):
+        seen[0].metadata.labels["x"] = "y"
+    assert cs.store.get("TPUJob", "default", "live").metadata.labels == {}
+    stop.set()
+    inf.join(2)
+
+
+def test_indexer_freezes_old_style_mutable_objects_on_admission():
+    """Objects fed from a remote (non-frozen) list/watch are frozen once
+    at cache admission — after that, the same no-alias guarantees hold."""
+    from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client import Indexer
+
+    idx = Indexer()
+    mutable = serde.roundtrip(job())  # fresh, unfrozen decode
+    assert not is_frozen(mutable)
+    idx.add(mutable)
+    cached = idx.get_by_key("default/iso")
+    assert is_frozen(cached)
+    with pytest.raises(FrozenObjectError):
+        cached.metadata.name = "evil"

@@ -9,6 +9,7 @@
 - ``ratelimit``  token bucket + per-item backoff limiters
 """
 
+from tfk8s_tpu.api.frozen import FrozenObjectError, freeze, is_frozen, thaw  # noqa: F401
 from tfk8s_tpu.client.store import (  # noqa: F401
     AlreadyExists,
     ClusterStore,

@@ -18,8 +18,9 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Tuple
 
 from tfk8s_tpu import API_VERSION, GROUP, VERSION
+from tfk8s_tpu.api.frozen import thaw
 from tfk8s_tpu.client.ratelimit import TokenBucketRateLimiter
-from tfk8s_tpu.client.store import ClusterStore, Watch
+from tfk8s_tpu.client.store import AlreadyExists, ClusterStore, Watch
 
 
 @dataclasses.dataclass
@@ -58,15 +59,47 @@ class TypedClient:
 
     def create(self, obj: Any) -> Any:
         self._limiter.accept()
+        return self._do_create(obj)
+
+    def _do_create(self, obj: Any) -> Any:
+        """The unmetered create body — ``create``/``create_many`` meter
+        around it; the recording fake overrides it to keep per-object
+        action records and reactors working under batching."""
         if self.namespace is not None:
             obj.metadata.namespace = self.namespace
         return self._store.create(obj)
 
+    def create_many(self, objs: List[Any]) -> List[Any]:
+        """Create a batch under ONE rate-limiter acquire (a single
+        reservation of ``len(objs)`` tokens — one sleep instead of one
+        per object; the gang-pod creation path). AlreadyExists is
+        skipped per object (idempotent, level-triggered create — the
+        caller recomputes desired state next sync anyway). Returns the
+        objects actually created."""
+        if not objs:
+            return []
+        self._limiter.accept(len(objs))
+        created: List[Any] = []
+        for obj in objs:
+            try:
+                created.append(self._do_create(obj))
+            except AlreadyExists:
+                continue
+        return created
+
     def get(self, name: str) -> Any:
+        """Read one object. Returns a PRIVATE MUTABLE copy (copy-on-read
+        at the client boundary): the store's frozen shared instance is
+        thawed here, because typed-client readers are exactly the
+        mutating clients — the kubelet's read-modify-write status loop,
+        the event recorder's aggregation. Zero-copy shared reads are the
+        lister/informer path."""
         self._limiter.accept()
-        return self._store.get(self.kind, self._ns(), name)
+        return thaw(self._store.get(self.kind, self._ns(), name))
 
     def list(self, label_selector: Optional[Dict[str, str]] = None) -> Tuple[List[Any], int]:
+        """List (items, rv). Items from a local store are the SHARED
+        frozen instances — read-only; thaw() any you need to edit."""
         self._limiter.accept()
         return self._store.list(self.kind, self.namespace, label_selector)
 

@@ -38,7 +38,10 @@ class _RecordingClient(TypedClient):
     def _react(self, action: Action, obj: Any = None):
         return self._parent._dispatch(action, obj)
 
-    def create(self, obj: Any) -> Any:
+    def _do_create(self, obj: Any) -> Any:
+        # Overriding the unmetered body (not create itself) keeps
+        # per-object action records and reactors working when the
+        # controller batches a gang through create_many.
         a = Action("create", self.kind, self._ns(obj), obj.metadata.name)
         handled, result = self._react(a, obj)
         if handled:
@@ -52,7 +55,7 @@ class _RecordingClient(TypedClient):
             from tfk8s_tpu.api import set_defaults
 
             set_defaults(obj)
-        return super().create(obj)
+        return super()._do_create(obj)
 
     def get(self, name: str) -> Any:
         a = Action("get", self.kind, self._ns(), name)

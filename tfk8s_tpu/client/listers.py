@@ -1,6 +1,12 @@
 """Listers: read-only indexed access over an informer's cache — SURVEY.md
 C14 (``pkg/client/listers/tensorflow/v1alpha1/tfjob.go``; the
 ``store.Indexer.GetByKey(key)`` read path at k8s-operator.md:160).
+
+Results are the SHARED frozen cached instances (copy-on-write contract,
+``api/frozen.py``): a lister read costs a dict lookup, and mutating a
+result raises ``FrozenObjectError`` instead of corrupting the cache.
+Controllers that edit an object first take a private copy (the TPUJob
+controller's ``serde.roundtrip`` / ``thaw``).
 """
 
 from __future__ import annotations

@@ -15,8 +15,16 @@ from typing import Dict, Hashable
 
 class TokenBucketRateLimiter:
     """Classic token bucket: ``qps`` refill rate, ``burst`` capacity.
-    ``accept()`` blocks until a token is available; ``try_accept()`` doesn't.
-    """
+    ``accept(n)`` blocks until ``n`` tokens are available; ``try_accept()``
+    doesn't block.
+
+    ``accept`` is reservation-style (flowcontrol's ``WaitN``): the tokens
+    are debited immediately — the balance may go negative — and the
+    caller sleeps once for exactly the debt. One ``accept(n)`` is
+    therefore a single batched wait, which is how a gang's n pod creates
+    pay the rate limiter once instead of sleeping n times on the
+    reconcile hot path; later callers queue behind the debt, preserving
+    the overall rate."""
 
     def __init__(self, qps: float, burst: int, clock=time.monotonic, sleep=time.sleep):
         if qps <= 0:
@@ -42,14 +50,14 @@ class TokenBucketRateLimiter:
                 return True
             return False
 
-    def accept(self) -> None:
-        while True:
-            with self._lock:
-                self._refill()
-                if self._tokens >= 1.0:
-                    self._tokens -= 1.0
-                    return
-                wait = (1.0 - self._tokens) / self.qps
+    def accept(self, n: int = 1) -> None:
+        if n <= 0:
+            return
+        with self._lock:
+            self._refill()
+            self._tokens -= float(n)
+            wait = -self._tokens / self.qps if self._tokens < 0 else 0.0
+        if wait > 0:
             self._sleep(wait)
 
 

@@ -26,6 +26,23 @@ documents for the real apiserver:
   control-plane restart). Watchers reconnecting from a pre-restart rv that
   the replayed WAL no longer covers get :class:`Gone` and relist — the
   same recovery path as a compacted etcd.
+
+**Copy-on-write** (client-go's shared-informer discipline, enforced via
+``api/frozen.py``): every stored object is FROZEN once at the write
+barrier; ``get``/``list``/watch events then share that frozen instance
+by reference — zero copies on the read path, label-selector filtering
+runs on the stored objects before anything is materialized, and a
+consumer mutation raises :class:`~tfk8s_tpu.api.frozen.FrozenObjectError`
+instead of silently corrupting shared state. Write verbs still RETURN a
+private mutable copy (the pre-existing contract: callers edit the return
+and send it back as the next update). Mutating clients go through
+``thaw()`` (the typed client's ``get()`` does this for them).
+
+**Locking** is two-level: one lock per kind serializes that kind's
+bucket (so TPUJob status patches stop contending with Pod creates — the
+expensive merge/encode/decode work runs under the kind lock only), and a
+short store-wide commit lock orders rv assignment, the WAL append,
+history, and watch fanout.
 """
 
 from __future__ import annotations
@@ -36,13 +53,14 @@ import itertools
 import json
 import logging
 import os
-import queue
 import threading
 import time
 import uuid
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
+
+from tfk8s_tpu.api.frozen import FrozenObjectError, freeze, thaw  # noqa: F401
 
 log = logging.getLogger(__name__)
 
@@ -107,48 +125,144 @@ class EventType(str, enum.Enum):
 @dataclass
 class WatchEvent:
     type: EventType
-    object: Any  # a deep copy; safe to mutate
+    # The SHARED frozen stored instance — do not mutate; thaw() for a
+    # private mutable copy. (The event wrapper itself is per-watcher.)
+    object: Any
 
     @property
     def kind(self) -> str:
         return self.object.kind
 
 
-_SENTINEL = object()
+# Per-watcher pending-event bound: past it, same-key events coalesce
+# (the slow-watcher policy below) so one stalled consumer's backlog is
+# bounded by the number of DISTINCT live objects, not by event rate.
+DEFAULT_WATCH_QUEUE = 1024
+
+
+def _coalesce_type(pending: EventType, new: EventType) -> EventType:
+    """Merge two pending event types for one object so the consumer still
+    converges to the right level-triggered state: anything followed by
+    DELETED is a delete; an unseen ADDED absorbing updates stays ADDED.
+    A pending DELETED is never merged INTO (the push path treats it as a
+    barrier): collapsing delete+recreate would hide the deletion — and
+    the identity (uid) change — from consumers whose delete path does
+    real work (the kubelet stops the old pod's runner on delete)."""
+    if new == EventType.DELETED:
+        return EventType.DELETED
+    if pending == EventType.ADDED:
+        return EventType.ADDED
+    return EventType.MODIFIED
 
 
 class Watch:
     """One consumer's event stream. Iterate to receive events; ``stop()``
-    ends the iteration (the stopCh analogue, k8s-operator.md:200-203)."""
+    ends the iteration (the stopCh analogue, k8s-operator.md:200-203).
 
-    def __init__(self) -> None:
-        self._q: "queue.Queue[Any]" = queue.Queue()
+    The queue holds per-watcher event WRAPPERS around shared frozen
+    objects (no per-watcher deep copies). When a slow consumer's backlog
+    reaches ``queue_limit``, further events for an object that already
+    has one pending COALESCE into it (latest state wins — the informer
+    contract is level-triggered, so intermediate states are droppable);
+    events for new objects still append, bounding the backlog by the
+    live-object count. ``coalesced_total`` counts the merges."""
+
+    def __init__(self, queue_limit: int = DEFAULT_WATCH_QUEUE) -> None:
+        self._cond = threading.Condition()
+        self._items: Deque[WatchEvent] = deque()
+        # object key -> its (single) pending event, for O(1) coalescing
+        self._pending: Dict[str, WatchEvent] = {}
+        self._queue_limit = queue_limit
         self._stopped = False
+        self.coalesced_total = 0
 
-    def _push(self, ev: WatchEvent) -> None:
-        if not self._stopped:
-            self._q.put(ev)
+    @staticmethod
+    def _event_key(ev: WatchEvent) -> Optional[str]:
+        try:
+            return f"{ev.object.kind}/{ev.object.metadata.key}"
+        except AttributeError:
+            return None
+
+    def _push(self, ev: WatchEvent) -> bool:
+        """Enqueue one event (the wrapper becomes watcher-owned). Returns
+        True when it coalesced into an already-pending event."""
+        with self._cond:
+            if self._stopped:
+                return False
+            key = self._event_key(ev)
+            if (
+                self._queue_limit
+                and len(self._items) >= self._queue_limit
+                and key is not None
+            ):
+                pending = self._pending.get(key)
+                # a pending DELETED is a barrier: a re-ADD after it must
+                # be delivered separately or the consumer never sees the
+                # deletion (and the uid change) at all
+                if pending is not None and pending.type != EventType.DELETED:
+                    pending.type = _coalesce_type(pending.type, ev.type)
+                    pending.object = ev.object
+                    self.coalesced_total += 1
+                    return True
+            self._items.append(ev)
+            if key is not None:
+                self._pending[key] = ev
+            self._cond.notify()
+            return False
+
+    def _pop_locked(self) -> WatchEvent:
+        ev = self._items.popleft()
+        key = self._event_key(ev)
+        if key is not None and self._pending.get(key) is ev:
+            del self._pending[key]
+        return ev
 
     def stop(self) -> None:
-        self._stopped = True
-        self._q.put(_SENTINEL)
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
 
     def __iter__(self) -> Iterator[WatchEvent]:
         while True:
-            item = self._q.get()
-            if item is _SENTINEL or self._stopped:
-                return
-            yield item
+            with self._cond:
+                while not self._items and not self._stopped:
+                    self._cond.wait()
+                if self._stopped:
+                    return
+                ev = self._pop_locked()
+            yield ev
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
-        """Blocking pop with timeout; None on timeout or stop."""
-        try:
-            item = self._q.get(timeout=timeout)
-        except queue.Empty:
+        """Blocking pop with timeout; None on timeout or stop (already-
+        queued events are still drained after stop)."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._items and not self._stopped:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._items:
+                return self._pop_locked()
             return None
-        if item is _SENTINEL:
-            return None
-        return item
+
+    def next_batch(
+        self, max_items: int = 256, timeout: Optional[float] = None
+    ) -> List[WatchEvent]:
+        """Blocking pop of up to ``max_items`` already-queued events — one
+        wakeup drains a burst, which is what lets the Reflector apply N
+        rapid updates as one batch. Empty list on timeout or stop."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._items and not self._stopped:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+            out: List[WatchEvent] = []
+            while self._items and len(out) < max_items:
+                out.append(self._pop_locked())
+            return out
 
 
 def _key(namespace: str, name: str) -> str:
@@ -215,6 +329,14 @@ class ClusterStore:
     replays both and resumes the rv sequence. ``fsync=False`` trades
     power-loss durability for write latency (kill -9 survival only needs
     the page cache, so tests and the control-plane bench may disable it).
+
+    Read contract (copy-on-write, module docstring): ``get``/``list``
+    return the SHARED frozen stored instance; mutating it raises
+    ``FrozenObjectError``. Write verbs return a private mutable copy.
+
+    ``metrics`` (optional registry) exports
+    ``tfk8s_watch_coalesced_total{kind}`` — events merged into a slow
+    watcher's pending backlog instead of delivered individually.
     """
 
     def __init__(
@@ -223,8 +345,18 @@ class ClusterStore:
         journal_dir: Optional[str] = None,
         compact_every: int = 4096,
         fsync: bool = True,
+        metrics=None,
+        watch_queue_limit: int = DEFAULT_WATCH_QUEUE,
     ) -> None:
+        # Store-wide commit lock: rv sequence, WAL, history ring, watcher
+        # registry, fanout. Held only for the (cheap) commit step; the
+        # expensive per-object work runs under the kind lock.
         self._lock = threading.RLock()
+        # One lock per kind serializes that kind's bucket: a TPUJob
+        # status patch (encode+merge+decode under its kind lock) no
+        # longer blocks a concurrent Pod create. Lock order is ALWAYS
+        # kind lock -> commit lock, never the reverse.
+        self._kind_locks: Dict[str, threading.RLock] = {}
         self._objects: Dict[str, Dict[str, Any]] = {}
         self._rv = itertools.count(1)
         self._last_rv = 0
@@ -234,11 +366,27 @@ class ClusterStore:
         self._journal_dir = journal_dir
         self._compact_every = compact_every
         self._fsync = fsync
+        self._metrics = metrics
+        self._watch_queue_limit = watch_queue_limit
         self._wal = None  # append handle on wal.jsonl
         self._wal_records = 0
         self._poisoned = False
+        if metrics is not None:
+            metrics.describe(
+                "tfk8s_watch_coalesced_total",
+                "Watch events merged into a slow watcher's pending "
+                "backlog (latest state wins) instead of delivered "
+                "individually.",
+            )
         if journal_dir is not None:
             self._open_journal()
+
+    def _kind_lock(self, kind: str) -> threading.RLock:
+        lock = self._kind_locks.get(kind)
+        if lock is None:
+            with self._lock:
+                lock = self._kind_locks.setdefault(kind, threading.RLock())
+        return lock
 
     # -- journal ------------------------------------------------------------
 
@@ -262,7 +410,7 @@ class ClusterStore:
                 snap = json.load(f)
             self._last_rv = snap["rv"]
             for data in snap["objects"]:
-                obj = serde.decode_object(data)
+                obj = freeze(serde.decode_object(data))
                 self._bucket(obj.kind)[obj.metadata.key] = obj
         good_end = 0
         if os.path.exists(self._wal_path):
@@ -278,7 +426,7 @@ class ClusterStore:
                         break
                     try:
                         rec = json.loads(line)
-                        obj = serde.decode_object(rec["obj"])
+                        obj = freeze(serde.decode_object(rec["obj"]))
                         etype = EventType(rec["type"])
                     except (ValueError, KeyError) as e:
                         # A COMPLETE line that fails to decode is mid-file
@@ -410,60 +558,78 @@ class ClusterStore:
         self._last_rv = next(self._rv)
         return self._last_rv
 
-    def _emit(self, etype: EventType, obj: Any, apply=None) -> None:
-        """Journal, then commit, then notify — in that order. ``apply``
-        performs the actual bucket mutation; deferring it until after the
-        WAL append succeeds keeps the log write-AHEAD: a failed append
-        (ENOSPC, dead disk) raises to the client with NO state change, so
-        readers can never observe an object that a restart would forget."""
-        ev = WatchEvent(etype, copy.deepcopy(obj))
-        if self._wal is not None:
-            self._journal(etype, ev.object)
-        if apply is not None:
+    def _commit(self, etype: EventType, stored: Any, apply) -> Any:
+        """The write barrier: assign the rv, FREEZE the object (the one
+        structural walk per write — every read after this shares the
+        frozen instance), journal, apply the bucket mutation, fan out.
+        Called under the object's kind lock; takes the store-wide commit
+        lock for the ordered part. Journal-before-apply keeps the log
+        write-AHEAD: a failed append (ENOSPC, dead disk) raises to the
+        client with NO state change, so readers can never observe an
+        object that a restart would forget. Returns the frozen stored
+        object."""
+        with self._lock:
+            stored.metadata.resource_version = self._bump()
+            frozen_obj = freeze(stored)
+            ev = WatchEvent(etype, frozen_obj)
+            if self._wal is not None:
+                self._journal(etype, frozen_obj)
             apply()
-        # compact only AFTER the mutation is applied — a snapshot taken
-        # between journal and apply would miss the in-flight object and the
-        # WAL truncation would then destroy its only record. A compaction
-        # failure must NOT fail the (already committed and journaled)
-        # mutation: log it and retry at the next write, when
-        # _wal_records will still be over threshold.
-        if self._wal is not None and self._wal_records >= self._compact_every:
-            try:
-                self._compact()
-            except OSError as e:
-                log.warning("journal: compaction failed (will retry): %s", e)
-        self._history.append((obj.metadata.resource_version, ev))
-        for kind, w in list(self._watchers):
-            if kind == obj.kind:
-                # per-watcher copy so consumers can't race each other
-                w._push(WatchEvent(etype, copy.deepcopy(ev.object)))
+            # compact only AFTER the mutation is applied — a snapshot
+            # taken between journal and apply would miss the in-flight
+            # object and the WAL truncation would then destroy its only
+            # record. A compaction failure must NOT fail the (already
+            # committed and journaled) mutation: log it and retry at the
+            # next write, when _wal_records will still be over threshold.
+            if self._wal is not None and self._wal_records >= self._compact_every:
+                try:
+                    self._compact()
+                except OSError as e:
+                    log.warning("journal: compaction failed (will retry): %s", e)
+            self._history.append((stored.metadata.resource_version, ev))
+            kind = frozen_obj.kind
+            for wkind, w in self._watchers:
+                if wkind == kind:
+                    # one shared frozen object; only the tiny per-watcher
+                    # event wrapper is allocated here
+                    if w._push(WatchEvent(etype, frozen_obj)) and (
+                        self._metrics is not None
+                    ):
+                        self._metrics.inc(
+                            "tfk8s_watch_coalesced_total", 1.0, {"kind": kind}
+                        )
+        return frozen_obj
 
     def _bucket(self, kind: str) -> Dict[str, Any]:
-        return self._objects.setdefault(kind, {})
+        bucket = self._objects.get(kind)
+        if bucket is None:
+            bucket = self._objects.setdefault(kind, {})
+        return bucket
 
     # -- CRUD ---------------------------------------------------------------
 
     def create(self, obj: Any) -> Any:
-        with self._lock:
+        with self._kind_lock(obj.kind):
             bucket = self._bucket(obj.kind)
             k = obj.metadata.key
             if k in bucket:
                 raise AlreadyExists(f"{obj.kind} {k} already exists")
-            stored = copy.deepcopy(obj)
+            stored = copy.deepcopy(obj)  # the write-barrier copy
             stored.metadata.uid = stored.metadata.uid or uuid.uuid4().hex
             stored.metadata.creation_timestamp = (
                 stored.metadata.creation_timestamp or time.time()
             )
-            stored.metadata.resource_version = self._bump()
-            self._emit(
+            self._commit(
                 EventType.ADDED, stored, apply=lambda: bucket.__setitem__(k, stored)
             )
             return copy.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> Any:
-        with self._lock:
+        """Returns the SHARED frozen stored instance (zero-copy read);
+        mutate via ``thaw()`` only."""
+        with self._kind_lock(kind):
             try:
-                return copy.deepcopy(self._bucket(kind)[_key(namespace, name)])
+                return self._bucket(kind)[_key(namespace, name)]
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name} not found") from None
 
@@ -474,21 +640,27 @@ class ClusterStore:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> Tuple[List[Any], int]:
         """Returns (items, resource_version) — the rv is the point to start
-        watching from (List-then-Watch, images/informer1.png)."""
-        with self._lock:
-            items = []
-            for obj in self._bucket(kind).values():
-                if namespace is not None and obj.metadata.namespace != namespace:
-                    continue
-                if label_selector and not match_labels(label_selector, obj.metadata.labels):
-                    continue
-                items.append(copy.deepcopy(obj))
+        watching from (List-then-Watch, images/informer1.png). Items are
+        the SHARED frozen stored instances: the namespace/label filter
+        runs directly on stored objects and nothing is copied — a
+        selective list over a large bucket costs only the matches'
+        references."""
+        with self._kind_lock(kind):
+            items = [
+                obj
+                for obj in self._bucket(kind).values()
+                if (namespace is None or obj.metadata.namespace == namespace)
+                and (
+                    not label_selector
+                    or match_labels(label_selector, obj.metadata.labels)
+                )
+            ]
             return items, self._last_rv
 
     def update(self, obj: Any) -> Any:
         """Write with optimistic-concurrency check. Clearing the last
         finalizer on a deletion-marked object completes the delete."""
-        with self._lock:
+        with self._kind_lock(obj.kind):
             bucket = self._bucket(obj.kind)
             k = obj.metadata.key
             if k not in bucket:
@@ -499,7 +671,7 @@ class ClusterStore:
                     f"{obj.kind} {k}: resource_version "
                     f"{obj.metadata.resource_version} != {current.metadata.resource_version}"
                 )
-            stored = copy.deepcopy(obj)
+            stored = copy.deepcopy(obj)  # the write-barrier copy
             stored.metadata.uid = current.metadata.uid
             stored.metadata.creation_timestamp = current.metadata.creation_timestamp
             # deletion_timestamp is set by delete(), never by clients
@@ -508,13 +680,11 @@ class ClusterStore:
                 stored.metadata.deletion_timestamp is not None
                 and not stored.metadata.finalizers
             ):
-                stored.metadata.resource_version = self._bump()
-                self._emit(
+                self._commit(
                     EventType.DELETED, stored, apply=lambda: bucket.pop(k)
                 )
                 return copy.deepcopy(stored)
-            stored.metadata.resource_version = self._bump()
-            self._emit(
+            self._commit(
                 EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)
             )
             return copy.deepcopy(stored)
@@ -525,7 +695,7 @@ class ClusterStore:
         riding along are discarded — the real apiserver's subresource
         isolation, so a status writer can never clobber a concurrent spec
         change it hasn't seen."""
-        with self._lock:
+        with self._kind_lock(obj.kind):
             bucket = self._bucket(obj.kind)
             k = obj.metadata.key
             if k not in bucket:
@@ -538,10 +708,9 @@ class ClusterStore:
                 )
             if not hasattr(current, "status"):
                 raise StoreError(f"{obj.kind} has no status subresource")
-            stored = copy.deepcopy(current)
+            stored = copy.deepcopy(current)  # thaws the frozen current
             stored.status = copy.deepcopy(obj.status)
-            stored.metadata.resource_version = self._bump()
-            self._emit(
+            self._commit(
                 EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)
             )
             return copy.deepcopy(stored)
@@ -573,23 +742,26 @@ class ClusterStore:
         trace, the same boundary a validating webhook gives PUT."""
         from tfk8s_tpu.api import serde
 
-        with self._lock:
+        with self._kind_lock(kind):
             bucket = self._bucket(kind)
             k = _key(namespace, name)
             if k not in bucket:
                 raise NotFound(f"{kind} {k} not found")
             current = bucket[k]
-            patch = copy.deepcopy(patch)
             md = patch.get("metadata")
             if md is not None and not isinstance(md, dict):
                 # the apiserver rejects non-object ROOTS with 400; a
                 # non-object metadata SUBTREE would otherwise crash the
-                # .pop below as a 500 — same request-content class: 422
+                # resourceVersion read below as a 500 — same
+                # request-content class: 422
                 raise Invalid(
                     f"{kind} {k}: patch metadata must be an object, got "
                     f"{type(md).__name__}"
                 )
-            pre_rv = (md or {}).pop("resourceVersion", None)
+            # the caller's patch is never mutated (no defensive deepcopy
+            # needed): the rv precondition is read in place — if it rides
+            # into the merge it is overwritten by the commit's fresh rv
+            pre_rv = (md or {}).get("resourceVersion")
             if pre_rv is not None:
                 try:
                     pre_rv = int(pre_rv)
@@ -617,15 +789,14 @@ class ClusterStore:
                 merged_status = merge_patch(
                     serde.to_wire(current.status), patch.get("status", {})
                 )
-                stored = copy.deepcopy(current)
+                stored = copy.deepcopy(current)  # thaws the frozen current
                 # an explicit {"status": null} resets to the DEFAULT
                 # status (key deletion semantics), never to None — a
                 # None status would crash every later status reader
                 stored.status = serde.from_dict(
                     type(current.status), merged_status or {}
                 )
-                stored.metadata.resource_version = self._bump()
-                self._emit(
+                self._commit(
                     EventType.MODIFIED, stored,
                     apply=lambda: bucket.__setitem__(k, stored),
                 )
@@ -633,8 +804,10 @@ class ClusterStore:
             if subresource is not None:
                 raise StoreError(f"unknown subresource {subresource!r}")
             # main-resource writes never touch status (subresource
-            # isolation, mirroring update())
-            patch.pop("status", None)
+            # isolation, mirroring update()); shallow-copy instead of
+            # mutating the caller's patch
+            if "status" in patch:
+                patch = {pk: pv for pk, pv in patch.items() if pk != "status"}
             cur_wire = serde.to_wire(current)
             merged = merge_patch(cur_wire, patch)
             # identity is immutable under PATCH (the real apiserver rejects
@@ -658,11 +831,9 @@ class ClusterStore:
             ):
                 # stripping the last finalizer via PATCH completes the
                 # delete, exactly like update()
-                stored.metadata.resource_version = self._bump()
-                self._emit(EventType.DELETED, stored, apply=lambda: bucket.pop(k))
+                self._commit(EventType.DELETED, stored, apply=lambda: bucket.pop(k))
                 return copy.deepcopy(stored)
-            stored.metadata.resource_version = self._bump()
-            self._emit(
+            self._commit(
                 EventType.MODIFIED, stored, apply=lambda: bucket.__setitem__(k, stored)
             )
             return copy.deepcopy(stored)
@@ -670,7 +841,7 @@ class ClusterStore:
     def delete(self, kind: str, namespace: str, name: str) -> Any:
         """Finalizer-aware delete (k8s-operator.md:36-43): with finalizers
         present only ``deletion_timestamp`` is set; otherwise remove."""
-        with self._lock:
+        with self._kind_lock(kind):
             bucket = self._bucket(kind)
             k = _key(namespace, name)
             if k not in bucket:
@@ -678,28 +849,37 @@ class ClusterStore:
             current = bucket[k]
             if current.metadata.finalizers:
                 if current.metadata.deletion_timestamp is None:
-                    marked = copy.deepcopy(current)
+                    marked = copy.deepcopy(current)  # thaws the frozen current
                     marked.metadata.deletion_timestamp = time.time()
-                    marked.metadata.resource_version = self._bump()
-                    self._emit(
+                    self._commit(
                         EventType.MODIFIED, marked,
                         apply=lambda: bucket.__setitem__(k, marked),
                     )
                     return copy.deepcopy(marked)
                 return copy.deepcopy(current)
-            removed = copy.deepcopy(current)
-            removed.metadata.resource_version = self._bump()
-            self._emit(EventType.DELETED, removed, apply=lambda: bucket.pop(k))
+            removed = copy.deepcopy(current)  # thaws the frozen current
+            self._commit(EventType.DELETED, removed, apply=lambda: bucket.pop(k))
             return copy.deepcopy(removed)
 
     # -- watch --------------------------------------------------------------
 
-    def watch(self, kind: str, since_rv: Optional[int] = None) -> Watch:
+    def watch(
+        self,
+        kind: str,
+        since_rv: Optional[int] = None,
+        queue_limit: Optional[int] = None,
+    ) -> Watch:
         """Open an event stream for ``kind``. With ``since_rv``, replay
         buffered events with rv > since_rv first; raise :class:`Gone` if the
-        buffer no longer reaches back that far."""
-        with self._lock:
-            w = Watch()
+        buffer no longer reaches back that far. Delivered event objects are
+        the shared frozen stored instances (WatchEvent docstring);
+        ``queue_limit`` overrides the store's per-watcher pending bound."""
+        with self._kind_lock(kind), self._lock:
+            w = Watch(
+                queue_limit=self._watch_queue_limit
+                if queue_limit is None
+                else queue_limit
+            )
             if since_rv is not None and since_rv < self._last_rv:
                 oldest_buffered = self._history[0][0] if self._history else None
                 # oldest_buffered None with last_rv > 0 means the store was
@@ -713,7 +893,7 @@ class ClusterStore:
                     )
                 for rv, ev in self._history:
                     if rv > since_rv and ev.object.kind == kind:
-                        w._push(WatchEvent(ev.type, copy.deepcopy(ev.object)))
+                        w._push(WatchEvent(ev.type, ev.object))
             self._watchers.append((kind, w))
             return w
 

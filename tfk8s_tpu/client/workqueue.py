@@ -65,10 +65,11 @@ class WorkQueue:
             )
 
     def _export_depth_locked(self) -> None:
-        if self._metrics is not None:
-            self._metrics.set_gauge(
-                "workqueue.depth", float(len(self._queue)), self._labels
-            )
+        # call sites skip the call entirely when no registry is attached
+        # (the hot add/get path must not pay even the no-op frame)
+        self._metrics.set_gauge(
+            "workqueue.depth", float(len(self._queue)), self._labels
+        )
 
     def add(self, item: Hashable) -> None:
         with self._cond:
@@ -81,7 +82,8 @@ class WorkQueue:
                     self._metrics.inc("workqueue.requeues_total", 1.0, self._labels)
                 return  # will requeue on done()
             self._queue.append(item)
-            self._export_depth_locked()
+            if self._metrics is not None:
+                self._export_depth_locked()
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Tuple[Optional[Hashable], bool]:
@@ -108,7 +110,8 @@ class WorkQueue:
                     self._metrics.observe(
                         "workqueue.queue_seconds", latency, self._labels
                     )
-            self._export_depth_locked()
+            if self._metrics is not None:
+                self._export_depth_locked()
             return item, False
 
     def pop_queue_latency(self, item: Hashable) -> Optional[float]:
@@ -126,7 +129,8 @@ class WorkQueue:
             if item in self._dirty:
                 self._queue.append(item)
                 self._added_at.setdefault(item, time.monotonic())
-                self._export_depth_locked()
+                if self._metrics is not None:
+                    self._export_depth_locked()
                 self._cond.notify()
 
     def __len__(self) -> int:

@@ -331,19 +331,22 @@ def _cmd_apiserver(args: argparse.Namespace) -> int:
                       "readonly credentials; nothing usable to embed")
             return 2
 
+    from tfk8s_tpu.utils.logging import Metrics
+
+    metrics = Metrics()
     store = ClusterStore(
         journal_dir=args.journal_dir or None,
         fsync=not args.no_fsync,
+        # watch-coalescing counter rides the apiserver's own /metrics
+        metrics=metrics,
     )
     if args.journal_dir:
         log.info(
             "journal: %s (replayed to rv %d)", args.journal_dir, store.resource_version
         )
-    from tfk8s_tpu.utils.logging import Metrics
-
     server = APIServer(
         store, host=args.host, port=args.port, tls=tls, auth=auth,
-        metrics=Metrics(),
+        metrics=metrics,
     )
     if args.write_kubeconfig:
         kc: dict = {"server": server.url}

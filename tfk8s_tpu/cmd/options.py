@@ -18,11 +18,13 @@ import socket
 import uuid
 from typing import Dict, Optional
 
+from tfk8s_tpu.controller.controller import DEFAULT_SYNC_WORKERS
+
 
 @dataclasses.dataclass
 class Options:
     # controller
-    workers: int = 2
+    workers: int = DEFAULT_SYNC_WORKERS
     resync_period_s: float = 0.0
     namespace: str = "default"
     # client rate limits (C10: token-bucket on the REST client)
@@ -60,8 +62,10 @@ class Options:
     @staticmethod
     def add_flags(parser: argparse.ArgumentParser) -> None:
         g = parser.add_argument_group("operator")
-        g.add_argument("--workers", type=int, default=2,
-                       help="reconcile worker count (Controller.Run N)")
+        g.add_argument("--workers", type=int, default=DEFAULT_SYNC_WORKERS,
+                       help="reconcile worker count (Controller.Run N; "
+                            "per-key in-flight exclusion makes raising "
+                            "this safe)")
         g.add_argument("--resync-period", type=float, default=0.0, dest="resync_period_s",
                        help="informer resync period in seconds (0 = disabled)")
         g.add_argument("--namespace", default="default")

@@ -41,6 +41,7 @@ class Server:
         # /traces advertises. Isolation (tests) swaps the global via
         # obs.trace.set_tracer, never per-Server.
         self.tracer = get_tracer()
+        self.metrics = Metrics()
         qps, burst = opts.qps, opts.burst
         if store is not None:
             self.store = store
@@ -57,13 +58,14 @@ class Server:
             self.store = store_from_kubeconfig(cfg)
             qps, burst = cfg.qps, cfg.burst
         else:
-            self.store = ClusterStore()
+            # the in-process store exports its watch-coalescing counter
+            # (tfk8s_watch_coalesced_total) on this server's /metrics
+            self.store = ClusterStore(metrics=self.metrics)
         self.clientset = Clientset.new_for_config(
             self.store, RESTConfig(qps=qps, burst=burst)
         )
         self.allocator = SliceAllocator(opts.capacity or None)
         self.recorder = EventRecorder(sink=self.clientset)
-        self.metrics = Metrics()
         # image-input decode metrics (tfk8s_images_decoded_total /
         # decode-seconds / queue-depth) land on this registry: in the
         # single-process deployment (operator + local kubelet + trainer

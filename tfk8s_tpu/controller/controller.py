@@ -36,6 +36,14 @@ from tfk8s_tpu.utils.logging import EventRecorder, Metrics, get_logger
 
 log = get_logger("controller")
 
+# Default reconcile workers. The workqueue's dirty/processing accounting
+# already guarantees per-key in-flight exclusion (one worker per key at a
+# time — the single-writer contract), so extra workers only add
+# parallelism across DIFFERENT keys; 4 keeps a burst of job submissions
+# from serializing behind one slow sync even on a 1-core box, where the
+# win is overlapping the waits (status round trips, rate-limiter sleeps).
+DEFAULT_SYNC_WORKERS = 4
+
 
 class Controller:
     """Informer-fed, workqueue-decoupled reconcile loop."""
@@ -70,6 +78,7 @@ class Controller:
             f"{name}.sync_seconds", "Wall time of one reconcile pass."
         )
         self._workers: List[threading.Thread] = []
+        self._stop_event: Optional[threading.Event] = None
 
     # -- enqueue paths (k8s-operator.md:132-150) ----------------------------
 
@@ -107,10 +116,22 @@ class Controller:
 
     # -- run loop (k8s-operator.md:184-203) ---------------------------------
 
-    def run(self, workers: int, stop: threading.Event, block: bool = True) -> bool:
+    def run(
+        self,
+        workers: int = DEFAULT_SYNC_WORKERS,
+        stop: Optional[threading.Event] = None,
+        block: bool = True,
+    ) -> bool:
         """Start informers, wait for cache sync, run N workers. With
         ``block=True`` this only returns after ``stop`` is set (the
-        reference's ``Run`` never returns until stopCh closes)."""
+        reference's ``Run`` never returns until stopCh closes). Workers
+        never process the same key concurrently (queue dedup), so the
+        count is safe to raise — see DEFAULT_SYNC_WORKERS. With ``stop``
+        omitted an internal event is created; :meth:`shutdown` sets it,
+        so the informer/worker threads remain stoppable."""
+        if stop is None:
+            stop = threading.Event()
+        self._stop_event = stop
         log.info("%s: starting", self.name)
         for inf in self.informers:
             inf.run(stop)
@@ -131,6 +152,10 @@ class Controller:
 
     def shutdown(self) -> None:
         log.info("%s: shutting down queue", self.name)
+        # release the informer reflector threads too — essential when
+        # run() fabricated the stop event (no other handle exists)
+        if self._stop_event is not None:
+            self._stop_event.set()
         self.queue.shut_down()
         for t in self._workers:
             t.join(timeout=5)

@@ -143,7 +143,12 @@ class LocalKubelet:
         )
         self._claimed: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
-        self._stop: Optional[threading.Event] = None
+        # Always a real Event (run() swaps in the caller's): every retry
+        # wait in this file can be a stop-aware _stop.wait, so shutdown
+        # never stalls behind a fixed sleep. _started gates the loops
+        # that must not spin before run().
+        self._stop: threading.Event = threading.Event()
+        self._started = False
         self._fail_counts: Dict[str, int] = {}
         # (pod key, uid) -> live log buffer, drained by the flusher
         self._log_bufs: Dict[Tuple[str, str], Deque[str]] = {}
@@ -158,6 +163,7 @@ class LocalKubelet:
 
     def run(self, stop: threading.Event) -> None:
         self._stop = stop
+        self._started = True
         tfk8s_logger = logging.getLogger("tfk8s")
         tfk8s_logger.addHandler(self._log_router)
         # The node agent must see container INFO logs even when the
@@ -188,7 +194,7 @@ class LocalKubelet:
 
         leases = self.cs.generic("Lease", "default")
         name = NODE_LEASE_PREFIX + self.name
-        while self._stop is not None and not self._stop.is_set():
+        while not self._stop.is_set():
             now = time.time()
             try:
                 try:
@@ -220,7 +226,7 @@ class LocalKubelet:
         status, so `logs` works mid-run (final flush rides the terminal
         _set_phase). Runs OUTSIDE the logging handler — a flush that
         itself logs (update conflicts) must not recurse into capture."""
-        while self._stop is not None and not self._stop.is_set():
+        while not self._stop.is_set():
             try:
                 with self._lock:
                     snapshot = {
@@ -386,7 +392,7 @@ class LocalKubelet:
                 time.sleep(0.05)
                 continue
             except (Unavailable, OSError) as e:
-                stopping = self._stop is not None and self._stop.is_set()
+                stopping = self._stop.is_set()
                 if stopping or time.monotonic() > deadline:
                     log.warning(
                         "%s: dropping %s -> %s (%s; %s)", self.name, pod_key,
@@ -397,10 +403,10 @@ class LocalKubelet:
                     "%s: apiserver unreachable writing %s -> %s; retrying: %s",
                     self.name, pod_key, phase, e,
                 )
-                if self._stop is not None:
-                    self._stop.wait(1.0)
-                else:
-                    time.sleep(1.0)
+                # stop-aware retry wait: a kubelet shutting down mid-
+                # outage must not stall a second per pending retry (the
+                # next loop iteration sees the stop and drops cleanly)
+                self._stop.wait(1.0)
 
     def _run_pod(self, pod: Pod, pod_stop: threading.Event) -> None:
         key, uid = pod.metadata.key, pod.metadata.uid
