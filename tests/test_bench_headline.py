@@ -52,10 +52,29 @@ FULL_IMAGE_BLOCK = {
     "image_native_vs_pil": 2.34,
 }
 
+FULL_SERVING_BLOCK = {
+    "serving_model": "mlp-256",
+    "serving_max_batch": 16,
+    "serving_batch_timeout_ms": 2.0,
+    "serving_queue_limit": 64,
+    "serving_sweep": [
+        {"offered_qps": 250, "achieved_qps": 249.8, "p50_ms": 3.1,
+         "p99_ms": 5.9, "mean_batch_occupancy": 1.4, "shed": 0},
+        {"offered_qps": 4000, "achieved_qps": 2310.4, "p50_ms": 18.2,
+         "p99_ms": 71.0, "mean_batch_occupancy": 14.2, "shed": 311},
+    ],
+    "serving_qps": 2310.4,
+    "serving_p50_ms": 18.2,
+    "serving_p99_ms": 71.0,
+    "serving_batch_occupancy": 14.2,
+    "serving_shed_total": 311,
+}
+
 
 def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
-        _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json"
+        _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
+        FULL_SERVING_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -65,8 +84,12 @@ def test_headline_is_one_json_line_under_the_ceiling():
     # detail-only blocks never ride the headline
     assert "control_plane" not in parsed["extra"]
     assert "noise" not in parsed["extra"]
+    assert "serving_sweep" not in parsed["extra"]
     # the driver's acceptance keys survive at normal sizes
     assert parsed["extra"]["img_per_sec_native"] == 1030.1
+    assert parsed["extra"]["serving_qps"] == 2310.4
+    assert parsed["extra"]["serving_p99_ms"] == 71.0
+    assert parsed["extra"]["serving_batch_occupancy"] == 14.2
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -74,7 +97,9 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     the degrade order keeps dropping optional keys until the line does."""
     fat = dict(FULL_EXTRA)
     fat["degraded_sections"] = [f"section_{i:03d}" for i in range(60)]
-    line = bench.build_headline(_detail(fat), FULL_IMAGE_BLOCK, None)
+    line = bench.build_headline(
+        _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK
+    )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
     parsed = json.loads(line)
@@ -87,4 +112,16 @@ def test_headline_without_image_block():
     line = bench.build_headline(_detail(dict(FULL_EXTRA)), None, None)
     parsed = json.loads(line)
     assert "image_backend" not in parsed["extra"]
+    assert "serving_qps" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
+
+
+def test_serving_keys_in_drop_order():
+    """Every serving headline key must appear in the degrade order — a
+    key outside it could hold the line over the ceiling forever."""
+    import inspect
+
+    src = inspect.getsource(bench.build_headline)
+    for key in ("serving_qps", "serving_p50_ms", "serving_p99_ms",
+                "serving_batch_occupancy", "serving_model"):
+        assert f'"{key}"' in src, f"{key} missing from build_headline"

@@ -141,6 +141,82 @@ def test_get_watch_streams_changes(cluster, tmp_path, capsys):
     assert "DELETED   watched" in out
 
 
+def write_serve_manifest(tmp_path, name="cli-serve", replicas=2):
+    from tfk8s_tpu.api.types import TPUServe, TPUServeSpec
+
+    serve = TPUServe(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUServeSpec(task="echo", checkpoint="v1", replicas=replicas),
+    )
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps(serde.to_wire(serve)))
+    return str(path)
+
+
+def test_tpuserve_generic_verbs_roundtrip(cluster, tmp_path, capsys):
+    """ISSUE-5 satellite: the NEW kind rides the same generic verbs —
+    submit (by manifest kind), get table/json, describe, delete."""
+    _server, kc = cluster
+    manifest = write_serve_manifest(tmp_path)
+
+    assert main(["submit", "--kubeconfig", kc, "--file", manifest]) == 0
+    assert "tpuserve default/cli-serve created" in capsys.readouterr().out
+
+    assert main(["get", "--kubeconfig", kc, "--kind", "tpuserves"]) == 0
+    out = capsys.readouterr().out
+    assert "NAME" in out and "READY" in out and "cli-serve" in out
+    assert "0/2" in out  # no controller running here: 0 ready of 2 wanted
+
+    assert main([
+        "get", "--kubeconfig", kc, "--kind", "tpuserves", "cli-serve",
+        "-o", "json",
+    ]) == 0
+    objs = json.loads(capsys.readouterr().out)
+    assert objs[0]["kind"] == "TPUServe"
+    assert objs[0]["spec"]["task"] == "echo"
+    # admission defaulted the entrypoint on the server side
+    assert objs[0]["spec"]["template"]["entrypoint"].endswith("server:serve")
+
+    assert main([
+        "describe", "--kubeconfig", kc, "--kind", "tpuserves", "cli-serve",
+    ]) == 0
+    detail = json.loads(capsys.readouterr().out.split("\nEvents:")[0])
+    assert detail["spec"]["replicas"] == 2
+
+    assert main([
+        "delete", "--kubeconfig", kc, "--kind", "tpuserves", "cli-serve",
+    ]) == 0
+    assert "tpuserve default/cli-serve deleted" in capsys.readouterr().out
+    assert main([
+        "get", "--kubeconfig", kc, "--kind", "tpuserves", "cli-serve",
+    ]) == 1
+
+
+def test_get_label_selector_filters(cluster, tmp_path, capsys):
+    """`get -l a=b` filters server-side (the labelSelector query param)."""
+    from tfk8s_tpu.client.remote import RemoteStore
+
+    server, kc = cluster
+    store = RemoteStore(server.url)
+    for name, team in (("red-job", "red"), ("blue-job", "blue")):
+        job = TPUJob(
+            metadata=ObjectMeta(name=name, namespace="default",
+                                labels={"team": team}),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.WORKER: ReplicaSpec(
+                        replicas=1, template=ContainerSpec(entrypoint="t.e")
+                    )
+                },
+                tpu=TPUSpec(accelerator="cpu-1"),
+            ),
+        )
+        store.create(job)
+    assert main(["get", "--kubeconfig", kc, "-l", "team=red"]) == 0
+    out = capsys.readouterr().out
+    assert "red-job" in out and "blue-job" not in out
+
+
 def test_suspend_resume_verbs_flip_the_flag(cluster, tmp_path, capsys):
     from tfk8s_tpu.client.remote import RemoteStore
 

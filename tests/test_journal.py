@@ -128,6 +128,79 @@ class TestJournalRoundTrip:
         assert lease.spec.renew_time == 123.0
 
 
+class TestPerKindSegments:
+    """ISSUE-5 satellite: the WAL is segmented per kind (wal-<Kind>.jsonl)
+    so durable stores keep the per-kind-lock win — and restore-from-
+    segments merges every segment by rv."""
+
+    def test_writes_land_in_per_kind_segments(self, tmp_path):
+        from tfk8s_tpu.api.types import ObjectMeta, Pod
+
+        d = str(tmp_path / "j")
+        s = ClusterStore(journal_dir=d, fsync=False)
+        s.create(make_job("a"))
+        s.create(Pod(metadata=ObjectMeta(name="p0", namespace="default")))
+        s.close()
+        assert os.path.exists(os.path.join(d, "wal-TPUJob.jsonl"))
+        assert os.path.exists(os.path.join(d, "wal-Pod.jsonl"))
+        assert not os.path.exists(os.path.join(d, "wal.jsonl"))
+        with open(os.path.join(d, "wal-TPUJob.jsonl")) as f:
+            kinds = {json.loads(line)["obj"]["kind"] for line in f}
+        assert kinds == {"TPUJob"}
+
+    def test_restore_merges_segments_by_rv(self, tmp_path):
+        """Interleaved writes across kinds replay in rv order: the final
+        state AND the watch-replay history agree with the write order."""
+        from tfk8s_tpu.api.frozen import thaw as _thaw
+        from tfk8s_tpu.api.types import ObjectMeta, Pod
+
+        d = str(tmp_path / "j")
+        s = ClusterStore(journal_dir=d, fsync=False)
+        s.create(make_job("j1"))
+        pod = s.create(Pod(metadata=ObjectMeta(name="p1", namespace="default")))
+        bookmark = s.resource_version
+        j = _thaw(s.get("TPUJob", "default", "j1"))
+        j.spec.replica_specs[ReplicaType.WORKER].replicas = 8
+        s.update(j)
+        s.delete("Pod", "default", "p1")
+        last_rv = s.resource_version
+        s.close()
+
+        r = ClusterStore(journal_dir=d, fsync=False)
+        assert r.resource_version == last_rv
+        got = r.get("TPUJob", "default", "j1")
+        assert got.spec.replica_specs[ReplicaType.WORKER].replicas == 8
+        items, _ = r.list("Pod")
+        assert items == []  # the delete replayed AFTER the create
+        # cross-kind rv order also survives into watch replay
+        w = r.watch("Pod", since_rv=bookmark)
+        ev = w.next(timeout=1)
+        assert ev is not None and ev.type == EventType.DELETED
+        assert ev.object.metadata.uid == pod.metadata.uid
+
+    def test_legacy_single_stream_wal_still_replays(self, tmp_path):
+        """A pre-segment journal (single wal.jsonl) restores, and the next
+        compaction retires the legacy file."""
+        d = str(tmp_path / "j")
+        os.makedirs(d)
+        legacy = os.path.join(d, "wal.jsonl")
+        s = ClusterStore(journal_dir=d, fsync=False)
+        s.create(make_job("old-style"))
+        s.close()
+        # fabricate the legacy layout: fold the segment into wal.jsonl
+        seg = os.path.join(d, "wal-TPUJob.jsonl")
+        os.replace(seg, legacy)
+
+        r = ClusterStore(journal_dir=d, compact_every=2, fsync=False)
+        assert r.get("TPUJob", "default", "old-style").metadata.name == "old-style"
+        r.create(make_job("x1"))
+        r.create(make_job("x2"))  # crosses compact_every -> compaction
+        r.close()
+        assert not os.path.exists(legacy), "compaction must retire the legacy WAL"
+        r2 = ClusterStore(journal_dir=d, fsync=False)
+        assert len(r2.list("TPUJob")[0]) == 3
+
+
 class TestCompaction:
     def test_snapshot_written_and_wal_truncated(self, tmp_path):
         d = str(tmp_path / "j")
@@ -135,8 +208,9 @@ class TestCompaction:
         for i in range(12):
             s.create(make_job(f"job-{i:02d}"))
         assert os.path.exists(os.path.join(d, "snapshot.json"))
-        # wal holds only the records since the last compaction (< 5)
-        with open(os.path.join(d, "wal.jsonl")) as f:
+        # the kind's segment holds only the records since the last
+        # compaction (< 5)
+        with open(os.path.join(d, "wal-TPUJob.jsonl")) as f:
             assert len(f.readlines()) < 5
         last_rv = s.resource_version
         s.close()
@@ -144,6 +218,77 @@ class TestCompaction:
         items, _ = r.list("TPUJob")
         assert len(items) == 12
         assert r.resource_version == last_rv
+
+    def test_forced_compaction_bounds_wal_under_overlapping_commits(
+        self, tmp_path
+    ):
+        """The opportunistic compaction check (``_inflight == 0`` at apply)
+        can be starved forever by sustained overlapping multi-kind writes —
+        some commit is always inside its journal window. Past
+        FORCE_COMPACT_FACTOR x compact_every the store must stall new
+        commits, drain the in-flight set, and compact: WAL growth is
+        bounded, and no acked write is lost across the forced snapshot."""
+        import threading
+
+        from tfk8s_tpu.api.types import Pod
+        from tfk8s_tpu.client.store import FORCE_COMPACT_FACTOR
+
+        d = str(tmp_path / "j")
+        s = ClusterStore(journal_dir=d, compact_every=4, fsync=False)
+        s.create(Pod(metadata=ObjectMeta(name="p0", namespace="default")))
+        # park one Pod commit inside its journal window (_inflight == 1)
+        seg = s._segments["Pod"]
+        entered, release = threading.Event(), threading.Event()
+        orig_append = seg.append
+
+        def gated_append(line):
+            entered.set()
+            assert release.wait(10)
+            orig_append(line)
+
+        seg.append = gated_append
+        t = threading.Thread(
+            target=s.create,
+            args=(Pod(metadata=ObjectMeta(name="p1", namespace="default")),),
+        )
+        t.start()
+        assert entered.wait(10)
+
+        # flood another kind: every opportunistic check sees the parked
+        # commit and skips, until the forced bound flips compact_pending
+        n = 0
+        while s._wal_records < 4 * FORCE_COMPACT_FACTOR:
+            s.create(make_job(f"flood-{n}"))
+            n += 1
+        assert s._compact_pending
+        assert not os.path.exists(os.path.join(d, "snapshot.json"))
+
+        # a new commit now stalls at rv-assign instead of growing the WAL
+        stalled_done = threading.Event()
+        t2 = threading.Thread(
+            target=lambda: (s.create(make_job("stalled")), stalled_done.set()),
+        )
+        t2.start()
+        assert not stalled_done.wait(0.3)
+
+        # the parked commit applies -> inflight drains -> it compacts and
+        # releases the stalled writer
+        release.set()
+        t.join(10)
+        assert stalled_done.wait(10)
+        t2.join(10)
+        assert not s._compact_pending
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        assert s._wal_records == 1  # just the post-compaction stalled write
+        s.close()
+
+        r = ClusterStore(journal_dir=d, fsync=False)
+        pods, _ = r.list("Pod")
+        assert {p.metadata.name for p in pods} == {"p0", "p1"}
+        jobs, _ = r.list("TPUJob")
+        assert {j.metadata.name for j in jobs} == (
+            {f"flood-{i}" for i in range(n)} | {"stalled"}
+        )
 
     def test_pre_compaction_watch_rv_gets_410(self, tmp_path):
         """After restart the replayed history reaches back only to the last
@@ -167,14 +312,15 @@ class TestCompaction:
 
 class TestTornTail:
     def test_partial_final_line_truncated(self, tmp_path):
-        """kill -9 mid-write leaves a torn last line; recovery keeps every
-        complete (= acknowledged) record and the store stays writable."""
+        """kill -9 mid-write leaves a torn last line in one segment;
+        recovery keeps every complete (= acknowledged) record and the
+        store stays writable."""
         d = str(tmp_path / "j")
         s = ClusterStore(journal_dir=d, fsync=False)
         s.create(make_job("kept"))
         last_rv = s.resource_version
         s.close()
-        wal = os.path.join(d, "wal.jsonl")
+        wal = os.path.join(d, "wal-TPUJob.jsonl")
         with open(wal, "ab") as f:
             f.write(b'{"rv": 99, "type": "ADDED", "obj": {"kind": "TPU')  # torn
 
@@ -198,7 +344,7 @@ class TestTornTail:
         s.create(make_job("first"))
         s.create(make_job("second"))
         s.close()
-        wal = os.path.join(d, "wal.jsonl")
+        wal = os.path.join(d, "wal-TPUJob.jsonl")
         lines = open(wal, "rb").read().splitlines(keepends=True)
         corrupted = (
             lines[0]
@@ -417,10 +563,11 @@ class TestAppendFailure:
         d = str(tmp_path / "j")
         s = ClusterStore(journal_dir=d, fsync=False)
         s.create(make_job("good"))
-        wal = os.path.join(d, "wal.jsonl")
+        seg = s._segments["TPUJob"]
+        wal = seg.path
         good_bytes = open(wal, "rb").read()
 
-        class FailingWal:
+        class FailingFile:
             def __init__(self, inner):
                 self._inner = inner
             def tell(self):
@@ -431,13 +578,13 @@ class TestAppendFailure:
             def __getattr__(self, name):
                 return getattr(self._inner, name)
 
-        s._wal = FailingWal(s._wal)
+        seg._f = FailingFile(seg._f)
         with pytest.raises(OSError):
             s.create(make_job("doomed"))
         # nothing observable: reads see no ghost object...
         with pytest.raises(StoreError):
             s.get("TPUJob", "default", "doomed")
-        # ...the WAL is byte-identical to its last good state...
+        # ...the segment is byte-identical to its last good state...
         assert open(wal, "rb").read() == good_bytes
         # ...and the store recovered a working handle: next write lands
         s.create(make_job("after-enospc"))
@@ -448,12 +595,41 @@ class TestAppendFailure:
         ]
         r.close()
 
+    def test_failed_append_on_one_kind_leaves_other_kinds_writable(self, tmp_path):
+        """Per-kind segments isolate IO failure: a dead TPUJob segment
+        (rolled back cleanly) does not stop Pod writes from journaling."""
+        from tfk8s_tpu.api.types import ObjectMeta, Pod
+
+        d = str(tmp_path / "j")
+        s = ClusterStore(journal_dir=d, fsync=False)
+        s.create(make_job("good"))
+
+        class FailingFile:
+            def __init__(self, inner):
+                self._inner = inner
+            def tell(self):
+                return self._inner.tell()
+            def write(self, data):
+                raise OSError(28, "No space left on device")
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        s._segments["TPUJob"]._f = FailingFile(s._segments["TPUJob"]._f)
+        with pytest.raises(OSError):
+            s.create(make_job("doomed"))
+        s.create(Pod(metadata=ObjectMeta(name="p0", namespace="default")))
+        s.close()
+        r = ClusterStore(journal_dir=d, fsync=False)
+        assert [o.metadata.name for o in r.list("TPUJob")[0]] == ["good"]
+        assert [o.metadata.name for o in r.list("Pod")[0]] == ["p0"]
+        r.close()
+
     def test_unrecoverable_append_poisons_the_store(self, tmp_path, monkeypatch):
         d = str(tmp_path / "j")
         s = ClusterStore(journal_dir=d, fsync=False)
         s.create(make_job("good"))
 
-        class DoomedWal:
+        class DoomedFile:
             def tell(self):
                 return 0
             def write(self, data):
@@ -461,13 +637,15 @@ class TestAppendFailure:
             def close(self):
                 raise OSError(5, "I/O error")
 
-        s._wal = DoomedWal()
-        # simulate the rollback ALSO failing: reopening wal.jsonl for
+        s._segments["TPUJob"]._f = DoomedFile()
+        # simulate the rollback ALSO failing: reopening the segment for
         # append raises (the on-disk file itself stays intact) -> poison
         real_open = open
 
         def failing_open(path, *a, **kw):
-            if str(path).endswith("wal.jsonl") and "a" in (a[0] if a else kw.get("mode", "")):
+            if str(path).endswith("wal-TPUJob.jsonl") and "a" in (
+                a[0] if a else kw.get("mode", "")
+            ):
                 raise OSError(5, "I/O error")
             return real_open(path, *a, **kw)
 
@@ -475,14 +653,14 @@ class TestAppendFailure:
         with pytest.raises(OSError):
             s.create(make_job("doomed"))
         monkeypatch.undo()
-        # poisoned: EVERY further mutation refuses (availability traded
-        # for durability, per the docstring)
+        # poisoned: EVERY further mutation refuses — including OTHER kinds
+        # (availability traded for durability, per the docstring)
         with pytest.raises(StoreError, match="poisoned"):
             s.create(make_job("rejected"))
         # ...and the durability half of the trade holds: the intact WAL
         # re-replays every ACKED record on restart, exactly what the
         # poison message promises ("restart the apiserver to re-replay")
-        s._wal = None  # DoomedWal.close raises; drop it instead
+        s._segments.pop("TPUJob")  # DoomedFile.close raises; drop it instead
         r = ClusterStore(journal_dir=d, fsync=False)
         assert [o.metadata.name for o in r.list("TPUJob")[0]] == ["good"]
         r.close()

@@ -47,12 +47,22 @@ def test_operator_deployment_manifest_shape():
 def test_example_manifests_decode_default_validate():
     paths = sorted(glob.glob(os.path.join(REPO, "manifests", "examples", "*.yaml")))
     assert paths, "no example manifests found"
+    seen_kinds = set()
     for path in paths:
-        job = load_manifest(path)
-        defaults.set_defaults(job)
-        errs = validation.validate(job)
-        assert errs == [], f"{os.path.basename(path)}: {errs}"
-        assert job.spec.replica_specs, path
+        obj = load_manifest(path)
+        seen_kinds.add(obj.kind)
+        if obj.kind == "TPUServe":
+            defaults.set_serve_defaults(obj)
+            errs = validation.validate_serve(obj)
+            assert errs == [], f"{os.path.basename(path)}: {errs}"
+            assert obj.spec.task, path
+        else:
+            defaults.set_defaults(obj)
+            errs = validation.validate(obj)
+            assert errs == [], f"{os.path.basename(path)}: {errs}"
+            assert obj.spec.replica_specs, path
+    # both workloads ship a reference manifest
+    assert {"TPUJob", "TPUServe"} <= seen_kinds
 
 
 def test_deployable_artifact_is_real():

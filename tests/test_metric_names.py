@@ -30,6 +30,19 @@ def test_default_scope_covers_hotpath_counters():
     wanted = {
         "tfk8s_watch_coalesced_total": False,
         "tfk8s_status_patches_skipped_total": False,
+        # ISSUE-5 serving series: the bench's serving block and the
+        # autoscaler key off these exact names
+        "tfk8s_serving_requests_total": False,
+        "tfk8s_serving_batches_total": False,
+        "tfk8s_serving_request_seconds": False,
+        "tfk8s_serving_queue_seconds": False,
+        "tfk8s_serving_execute_seconds": False,
+        "tfk8s_serving_queue_depth": False,
+        "tfk8s_serving_batch_occupancy": False,
+        "tfk8s_serving_ready_replicas": False,
+        "tfk8s_serving_smoothed_queue_depth": False,
+        "tfk8s_serving_scale_events_total": False,
+        "tfk8s_serving_rollouts_total": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

@@ -1,0 +1,466 @@
+"""Hermetic end-to-end serving tests (ISSUE-5 acceptance): a TPUServe
+submitted to the fake cluster, reconciled by the real serve controller,
+replicas executed by the local kubelet running the real model server —
+then real concurrent client traffic through ServeClient.
+
+Covers the acceptance criteria:
+- submit → replicas Ready (readiness gated on the server loading the
+  checkpoint and reporting through the kubelet's status publication);
+- concurrent client requests are served with measured batch occupancy > 1;
+- a checkpoint-ref update rolls replicas with ZERO failed requests;
+- the autoscaler scales up under sustained queue depth and back down
+  after cooldown without oscillating (asserted on the replica-count
+  transition sequence, not eyeballed).
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+import tfk8s_tpu.runtime.kubelet as kubelet_mod
+import tfk8s_tpu.trainer.serve_controller as sc_mod
+from tfk8s_tpu.api.helpers import get_serve_condition, serve_condition_is
+from tfk8s_tpu.api.types import (
+    AutoscalePolicy,
+    BatchingPolicy,
+    ObjectMeta,
+    RollingUpdatePolicy,
+    ServeConditionType,
+    TPUServe,
+    TPUServeSpec,
+)
+from tfk8s_tpu.client import FakeClientset
+from tfk8s_tpu.runtime import LocalKubelet
+from tfk8s_tpu.runtime.server import ServeClient, lookup_replica
+from tfk8s_tpu.trainer import TPUServeController
+from tfk8s_tpu.trainer import labels as L
+
+from conftest import wait_for
+
+
+def make_serve(name, replicas=2, checkpoint="v1", delay_ms=5.0, **spec_kw):
+    return TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task="echo",
+            checkpoint=checkpoint,
+            replicas=replicas,
+            batching=BatchingPolicy(
+                max_batch_size=8, batch_timeout_ms=5.0, queue_limit=256
+            ),
+            **spec_kw,
+        ),
+    )
+
+
+def _with_delay(serve, delay_ms):
+    serve.spec.template.env["TFK8S_SERVE_ECHO_DELAY_MS"] = str(delay_ms)
+    return serve
+
+
+@pytest.fixture
+def cluster(monkeypatch):
+    """Serve controller + kubelet against one fake cluster, with the
+    kubelet's status flush and the controller's periodic pass sped up so
+    readiness/load signals propagate on a test-friendly clock."""
+    monkeypatch.setattr(kubelet_mod, "LOG_FLUSH_SECONDS", 0.05)
+    monkeypatch.setattr(sc_mod, "AUTOSCALE_PERIOD_S", 0.1)
+    cs = FakeClientset()
+    ctrl = TPUServeController(cs)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, stop
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def get_serve(cs, name):
+    return cs.tpuserves().get(name)
+
+
+def ready_count(cs, name):
+    try:
+        return get_serve(cs, name).status.ready_replicas
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+class TestReadyAndBatching:
+    def test_replicas_ready_then_batched_traffic(self, cluster):
+        cs, ctrl, stop = cluster
+        cs.tpuserves().create(_with_delay(make_serve("echo-s", replicas=2), 5))
+        assert wait_for(lambda: ready_count(cs, "echo-s") == 2, timeout=30)
+        cur = get_serve(cs, "echo-s")
+        assert cur.status.updated_replicas == 2
+        assert cur.status.observed_version  # rollout (the first) completed
+        assert serve_condition_is(cur.status, ServeConditionType.AVAILABLE)
+        assert not serve_condition_is(cur.status, ServeConditionType.PROGRESSING)
+
+        # Ready is gated on the server's own report, not just RUNNING
+        pods, _ = cs.pods().list(label_selector=L.serve_selector("echo-s"))
+        assert len(pods) == 2
+        for p in pods:
+            assert p.status.training.get("serving_ready") == 1.0
+
+        client = ServeClient(cs, "echo-s")
+        n = 64
+        with ThreadPoolExecutor(16) as ex:
+            futs = [ex.submit(client.request, float(i)) for i in range(n)]
+            results = [f.result(timeout=30) for f in futs]
+        assert all(r["version"] == "v1" for r in results)
+        # measured batch occupancy ACROSS the replica set > 1: concurrent
+        # load against a 5ms model must batch
+        servers = [
+            lookup_replica(p.metadata.key) for p in pods
+        ]
+        servers = [s for s in servers if s is not None]
+        served = sum(s.served_total for s in servers)
+        batches = sum(s.batches_total for s in servers)
+        assert served == n
+        assert served / batches > 1.0, f"no batching: {served} in {batches}"
+
+    def test_failed_replica_is_replaced(self, cluster):
+        cs, ctrl, stop = cluster
+        serve = make_serve("heal-s", replicas=1)
+        # first attempt of the pod fails at launch; the controller must
+        # replace the carcass with a fresh pod that then readies up
+        serve.spec.template.env["TFK8S_TEST_FAIL_TIMES"] = "1"
+        cs.tpuserves().create(serve)
+        assert wait_for(lambda: ready_count(cs, "heal-s") == 1, timeout=30)
+        pods, _ = cs.pods().list(label_selector=L.serve_selector("heal-s"))
+        live = [p for p in pods if p.metadata.deletion_timestamp is None]
+        assert len(live) == 1
+
+    def test_delete_tears_down_replicas(self, cluster):
+        cs, ctrl, stop = cluster
+        cs.tpuserves().create(make_serve("gone-s", replicas=2))
+        assert wait_for(lambda: ready_count(cs, "gone-s") == 2, timeout=30)
+        cs.tpuserves().delete("gone-s")
+
+        def gone():
+            try:
+                get_serve(cs, "gone-s")
+                return False
+            except Exception:  # noqa: BLE001
+                pods, _ = cs.pods().list(
+                    label_selector=L.serve_selector("gone-s")
+                )
+                return not [
+                    p for p in pods if p.metadata.deletion_timestamp is None
+                ]
+
+        assert wait_for(gone, timeout=30)
+
+
+class TestRollingUpdate:
+    def test_checkpoint_update_rolls_with_zero_failed_requests(self, cluster):
+        cs, ctrl, stop = cluster
+        serve = _with_delay(
+            make_serve(
+                "roll-s", replicas=2,
+                rolling_update=RollingUpdatePolicy(max_surge=1, max_unavailable=0),
+            ),
+            2,
+        )
+        cs.tpuserves().create(serve)
+        assert wait_for(lambda: ready_count(cs, "roll-s") == 2, timeout=30)
+        v1_version = get_serve(cs, "roll-s").status.observed_version
+
+        client = ServeClient(cs, "roll-s")
+        errors = []
+        versions = set()
+        hammer_stop = threading.Event()
+
+        def hammer(i):
+            while not hammer_stop.is_set():
+                try:
+                    out = client.request(float(i), timeout=20)
+                    versions.add(out["version"])
+                except Exception as e:  # noqa: BLE001 — ANY failure breaks the contract
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # traffic flowing against v1
+
+        cs.tpuserves().patch("roll-s", {"spec": {"checkpoint": "v2"}})
+
+        def rolled():
+            cur = get_serve(cs, "roll-s")
+            return (
+                cur.status.observed_version
+                and cur.status.observed_version != v1_version
+                and cur.status.ready_replicas == 2
+                and cur.status.updated_replicas == 2
+            )
+
+        assert wait_for(rolled, timeout=60)
+        time.sleep(0.3)  # traffic flowing against v2
+        hammer_stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert not errors, f"requests failed during the rollout: {errors[:3]}"
+        assert versions == {"v1", "v2"}, (
+            f"traffic should have spanned both versions, saw {versions}"
+        )
+        # the surge rollout replaced the pods: all live pods carry the new
+        # template hash
+        cur = get_serve(cs, "roll-s")
+        pods, _ = cs.pods().list(label_selector=L.serve_selector("roll-s"))
+        live = [p for p in pods if p.metadata.deletion_timestamp is None]
+        assert {
+            p.metadata.labels[L.SERVE_VERSION] for p in live
+        } == {cur.status.observed_version}
+
+    def test_rollout_never_drops_below_availability_floor(self, cluster):
+        """max_unavailable=0: at every observation during the rollout at
+        least `replicas` replicas are Ready."""
+        cs, ctrl, stop = cluster
+        serve = make_serve(
+            "floor-s", replicas=2,
+            rolling_update=RollingUpdatePolicy(max_surge=1, max_unavailable=0),
+        )
+        cs.tpuserves().create(serve)
+        assert wait_for(lambda: ready_count(cs, "floor-s") == 2, timeout=30)
+        v1_version = get_serve(cs, "floor-s").status.observed_version
+
+        low_water = []
+        watch_stop = threading.Event()
+
+        def watch_floor():
+            while not watch_stop.is_set():
+                pods, _ = cs.pods().list(
+                    label_selector=L.serve_selector("floor-s")
+                )
+                ready = sum(1 for p in pods if sc_mod.pod_is_ready(p))
+                low_water.append(ready)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=watch_floor, daemon=True)
+        t.start()
+        cs.tpuserves().patch("floor-s", {"spec": {"checkpoint": "v2"}})
+        assert wait_for(
+            lambda: get_serve(cs, "floor-s").status.observed_version
+            not in ("", v1_version),
+            timeout=60,
+        )
+        assert wait_for(lambda: ready_count(cs, "floor-s") == 2, timeout=30)
+        watch_stop.set()
+        t.join(timeout=10)
+        assert low_water and min(low_water) >= 2, (
+            f"availability floor violated: min ready {min(low_water)}"
+        )
+
+
+class TestAutoscaler:
+    def test_scales_up_under_load_then_down_after_cooldown(self, cluster):
+        cs, ctrl, stop = cluster
+        serve = _with_delay(
+            make_serve(
+                "auto-s", replicas=1,
+                autoscale=AutoscalePolicy(
+                    enabled=True, min_replicas=1, max_replicas=3,
+                    target_queue_depth=1.0, high_band=1.25, low_band=0.5,
+                    cooldown_s=0.4,
+                ),
+            ),
+            20,  # 20 ms per batch: sustained submitters build real depth
+        )
+        serve.spec.batching.max_batch_size = 2
+        cs.tpuserves().create(serve)
+        assert wait_for(lambda: ready_count(cs, "auto-s") >= 1, timeout=30)
+
+        # record every spec.replicas transition (the autoscaler's output)
+        transitions = [1]
+        watch_stop = threading.Event()
+
+        def record():
+            while not watch_stop.is_set():
+                try:
+                    n = get_serve(cs, "auto-s").spec.replicas
+                except Exception:  # noqa: BLE001
+                    n = transitions[-1]
+                if n != transitions[-1]:
+                    transitions.append(n)
+                time.sleep(0.02)
+
+        rec = threading.Thread(target=record, daemon=True)
+        rec.start()
+
+        client = ServeClient(cs, "auto-s")
+        errors = []
+        hammer_stop = threading.Event()
+
+        def hammer(i):
+            while not hammer_stop.is_set():
+                try:
+                    client.request(float(i), timeout=30)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+
+        # sustained queue depth -> scale up past 1
+        assert wait_for(
+            lambda: get_serve(cs, "auto-s").spec.replicas > 1, timeout=60
+        ), "autoscaler never scaled up under sustained load"
+        peak = get_serve(cs, "auto-s").spec.replicas
+        assert wait_for(lambda: ready_count(cs, "auto-s") >= peak, timeout=30)
+
+        # load stops -> after cooldown it returns to min, stepwise
+        hammer_stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert wait_for(
+            lambda: get_serve(cs, "auto-s").spec.replicas == 1, timeout=60
+        ), "autoscaler never scaled back down after load stopped"
+        # let any straggling (would-be-oscillating) transition land
+        time.sleep(1.0)
+        watch_stop.set()
+        rec.join(timeout=10)
+
+        assert not errors, f"requests failed during scaling: {errors[:3]}"
+        # no oscillation: the transition sequence is unimodal — strictly
+        # rising to its peak, then strictly falling; never up-down-up
+        seq = transitions
+        peak_idx = seq.index(max(seq))
+        rising, falling = seq[: peak_idx + 1], seq[peak_idx:]
+        assert all(a < b for a, b in zip(rising, rising[1:])), seq
+        assert all(a > b for a, b in zip(falling, falling[1:])), seq
+        assert seq[-1] == 1 and max(seq) >= 2, seq
+
+    def test_scale_down_is_availability_gated(self, cluster):
+        """Review regression: scaling down while a RETAINED replica is
+        not Ready must not delete the ready extras first — the Ready
+        count never drops below the new floor."""
+        cs, ctrl, stop = cluster
+        serve = make_serve(
+            "shrink-s", replicas=3,
+            rolling_update=RollingUpdatePolicy(max_surge=1, max_unavailable=0),
+        )
+        cs.tpuserves().create(serve)
+        assert wait_for(lambda: ready_count(cs, "shrink-s") == 3, timeout=30)
+
+        low_water = []
+        watch_stop = threading.Event()
+
+        def watch_floor():
+            while not watch_stop.is_set():
+                pods, _ = cs.pods().list(
+                    label_selector=L.serve_selector("shrink-s")
+                )
+                low_water.append(sum(1 for p in pods if sc_mod.pod_is_ready(p)))
+                time.sleep(0.01)
+
+        t = threading.Thread(target=watch_floor, daemon=True)
+        t.start()
+        # knock out the retained index-0 replica and shrink in the same
+        # breath: its recreation is briefly not-ready while the extras
+        # (indices 1, 2) are the only Ready pods
+        pods, _ = cs.pods().list(label_selector=L.serve_selector("shrink-s"))
+        idx0 = next(
+            p for p in pods if p.metadata.labels[L.REPLICA_INDEX] == "0"
+        )
+        cs.pods().delete(idx0.metadata.name)
+        cs.tpuserves().patch("shrink-s", {"spec": {"replicas": 1}})
+        assert wait_for(
+            lambda: ready_count(cs, "shrink-s") == 1
+            and len([
+                p for p in cs.pods().list(
+                    label_selector=L.serve_selector("shrink-s")
+                )[0]
+                if p.metadata.deletion_timestamp is None
+                and p.status.phase.value not in ("Failed", "Succeeded")
+            ]) == 1,
+            timeout=30,
+        )
+        watch_stop.set()
+        t.join(timeout=10)
+        # floor for replicas=1 is 1: serving capacity never hit zero
+        assert low_water and min(low_water) >= 1, min(low_water)
+
+    def test_status_mirrors_smoothed_load(self, cluster):
+        cs, ctrl, stop = cluster
+        serve = _with_delay(
+            make_serve(
+                "load-s", replicas=1,
+                autoscale=AutoscalePolicy(
+                    enabled=True, min_replicas=1, max_replicas=1,
+                    target_queue_depth=100.0,  # never scales; just observes
+                    cooldown_s=10.0,
+                ),
+            ),
+            10,
+        )
+        cs.tpuserves().create(serve)
+        assert wait_for(lambda: ready_count(cs, "load-s") == 1, timeout=30)
+        client = ServeClient(cs, "load-s")
+        with ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(client.request, float(i)) for i in range(64)]
+            [f.result(timeout=30) for f in futs]
+        # the served traffic shows up in the smoothed qps signal
+        assert wait_for(
+            lambda: get_serve(cs, "load-s").status.qps > 0, timeout=30
+        )
+
+    def test_fractional_target_depth_sizes_scale_up_exactly(self):
+        """The scale-up target divides by the FLOAT target depth; a
+        fractional target must not truncate to int (ceil(20/2.5) = 8
+        replicas, not ceil(20/int(2.5)) = 10)."""
+        from tfk8s_tpu.api.types import Pod
+
+        cs = FakeClientset()
+        ctrl = TPUServeController(cs)
+        cs.tpuserves().create(
+            make_serve(
+                "frac-s", replicas=2,
+                autoscale=AutoscalePolicy(
+                    enabled=True, min_replicas=1, max_replicas=50,
+                    target_queue_depth=2.5, cooldown_s=0.0,
+                ),
+            )
+        )
+        pods = []
+        for i in range(2):
+            p = Pod(metadata=ObjectMeta(name=f"frac-{i}"))
+            p.status.training = {"serving_queue_depth": 10.0}
+            pods.append(p)
+        ctrl._autoscale(cs.tpuserves().get("frac-s"), pods)
+        assert cs.tpuserves().get("frac-s").spec.replicas == 8
+
+
+class TestConditions:
+    def test_scaled_to_zero_is_not_reported_available(self, cluster):
+        """replicas=0 is a legal manual state: Available must go False
+        with a reason that says why — never a contradictory
+        False/AllReplicasReady pair."""
+        cs, ctrl, stop = cluster
+        cs.tpuserves().create(make_serve("zero-s", replicas=1))
+        assert wait_for(lambda: ready_count(cs, "zero-s") == 1, timeout=30)
+        cs.tpuserves().patch("zero-s", {"spec": {"replicas": 0}})
+
+        def scaled_down():
+            st = get_serve(cs, "zero-s").status
+            c = get_serve_condition(st, ServeConditionType.AVAILABLE)
+            return (
+                st.ready_replicas == 0
+                and c is not None
+                and not c.status
+                and c.reason == "ScaledToZero"
+            )
+
+        assert wait_for(scaled_down, timeout=30)

@@ -1,0 +1,298 @@
+"""Unit contract of the dynamic micro-batching executor (ISSUE-5
+satellite): batch closes on size OR timeout, padding/bucketing never
+mixes incompatible shapes, the bounded queue sheds with the typed
+overload error, and the latency histograms observe every served request
+exactly once.
+
+Pure host-side threading — no control plane, no accelerator."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from tfk8s_tpu.runtime.server import (
+    Draining,
+    EchoModel,
+    ModelServer,
+    Overloaded,
+    RequestFailed,
+    ServedModel,
+)
+from tfk8s_tpu.utils.logging import Metrics
+
+
+class RecordingModel(ServedModel):
+    """Test model: records every executed batch (payloads + bucket), with
+    an optional gate that blocks execution until released — which lets a
+    test wedge the executor and fill the queue deterministically."""
+
+    version = "rec"
+
+    def __init__(self, gate: threading.Event = None):
+        self.batches = []
+        self.gate = gate
+        self.fail_batches = 0
+
+    def load(self):
+        pass
+
+    def bucket_of(self, payload):
+        # payloads are (shape_key, value) tuples; the key is the bucket
+        return payload[0]
+
+    def forward(self, payloads):
+        if self.gate is not None:
+            self.gate.wait(10)
+        if self.fail_batches > 0:
+            self.fail_batches -= 1
+            raise RuntimeError("injected model failure")
+        self.batches.append(list(payloads))
+        return [("ok", p) for p in payloads]
+
+
+def make_server(model=None, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("batch_timeout_s", 0.05)
+    kw.setdefault("queue_limit", 8)
+    kw.setdefault("metrics", Metrics())
+    return ModelServer(model or RecordingModel(), **kw).start()
+
+
+class TestBatchClose:
+    def test_batch_closes_on_size_before_timeout(self):
+        model = RecordingModel()
+        # a LONG timeout: only the size bound can close the batch quickly
+        s = make_server(model, max_batch_size=4, batch_timeout_s=5.0)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(4) as ex:
+            futs = [ex.submit(s.submit, ("a", i)) for i in range(4)]
+            for f in futs:
+                f.result(timeout=10)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, "size-full batch must not wait out the timeout"
+        assert s.batches_total >= 1
+        # all four landed in at most two batches (the first may have
+        # closed with whatever was queued when the batcher woke)
+        assert s.served_total == 4
+        assert s.drain()
+
+    def test_batch_closes_on_timeout_when_underfull(self):
+        model = RecordingModel()
+        s = make_server(model, max_batch_size=8, batch_timeout_s=0.03)
+        out = s.submit(("a", 1), timeout=5)
+        assert out == ("ok", ("a", 1))
+        assert s.batches_total == 1 and s.served_total == 1
+        assert model.batches == [[("a", 1)]]
+        assert s.drain()
+
+    def test_zero_timeout_serves_immediately(self):
+        s = make_server(RecordingModel(), batch_timeout_s=0.0)
+        assert s.submit(("a", 1), timeout=5) == ("ok", ("a", 1))
+        assert s.drain()
+
+
+class TestBucketing:
+    def test_incompatible_buckets_never_share_a_batch(self):
+        model = RecordingModel()
+        s = make_server(model, max_batch_size=8, batch_timeout_s=0.05,
+                        queue_limit=64)
+        with ThreadPoolExecutor(16) as ex:
+            futs = [
+                ex.submit(s.submit, (("shape-a" if i % 2 else "shape-b"), i))
+                for i in range(32)
+            ]
+            for f in futs:
+                f.result(timeout=10)
+        assert s.drain()
+        assert sum(len(b) for b in model.batches) == 32
+        for batch in model.batches:
+            kinds = {p[0] for p in batch}
+            assert len(kinds) == 1, f"mixed buckets in one batch: {kinds}"
+
+    def test_non_head_bucket_keeps_queue_position(self):
+        """Requests of another bucket left behind by a batch are served by
+        subsequent batches, FIFO."""
+        gate = threading.Event()
+        model = RecordingModel(gate)
+        s = make_server(model, max_batch_size=2, batch_timeout_s=0.01,
+                        queue_limit=16)
+        with ThreadPoolExecutor(6) as ex:
+            f_a = [ex.submit(s.submit, ("a", i)) for i in range(2)]
+            time.sleep(0.05)  # wedge: the a-batch is blocked in forward()
+            f_b = [ex.submit(s.submit, ("b", i)) for i in range(2)]
+            f_a2 = [ex.submit(s.submit, ("a", 10 + i)) for i in range(2)]
+            gate.set()
+            for f in f_a + f_b + f_a2:
+                f.result(timeout=10)
+        assert s.drain()
+        assert sum(len(b) for b in model.batches) == 6
+
+    def test_bad_payload_rejected_at_submit(self):
+        class Picky(ServedModel):
+            version = "p"
+
+            def load(self):
+                pass
+
+            def bucket_of(self, payload):
+                raise TypeError("wrong shape")
+
+            def forward(self, payloads):
+                return payloads
+
+        s = make_server(Picky())
+        with pytest.raises(TypeError):
+            s.submit(object())
+        assert s.drain()
+
+
+class TestBackpressure:
+    def test_bounded_queue_sheds_with_typed_overload(self):
+        gate = threading.Event()
+        model = RecordingModel(gate)
+        s = make_server(model, max_batch_size=1, batch_timeout_s=0.0,
+                        queue_limit=4)
+        # wedge the executor (its batch blocks in forward), then fill the
+        # queue past the bound
+        results = []
+        with ThreadPoolExecutor(8) as ex:
+            first = ex.submit(s.submit, ("a", 0))
+            time.sleep(0.05)
+            queued = [ex.submit(s.submit, ("a", 1 + i)) for i in range(4)]
+            time.sleep(0.05)
+            with pytest.raises(Overloaded) as exc_info:
+                s.submit(("a", 99))
+            assert exc_info.value.queue_limit == 4
+            assert exc_info.value.queue_depth == 4
+            gate.set()
+            results = [f.result(timeout=10) for f in [first] + queued]
+        assert len(results) == 5
+        assert s.rejected_total == 1
+        m = s.metrics.snapshot()
+        rejected = {
+            k: v for k, v in m["counters"].items()
+            if "requests_total" in k and 'outcome="rejected"' in k
+        }
+        assert sum(rejected.values()) == 1
+        assert s.drain()
+
+    def test_draining_rejects_new_but_finishes_queued(self):
+        gate = threading.Event()
+        model = RecordingModel(gate)
+        s = make_server(model, max_batch_size=1, batch_timeout_s=0.0,
+                        queue_limit=16)
+        with ThreadPoolExecutor(4) as ex:
+            inflight = [ex.submit(s.submit, ("a", i)) for i in range(3)]
+            time.sleep(0.05)
+            drainer = ex.submit(s.drain, 10)
+            time.sleep(0.05)
+            with pytest.raises(Draining):
+                s.submit(("a", 99))
+            gate.set()
+            # every ACCEPTED request completes even though drain started
+            assert [f.result(timeout=10) for f in inflight]
+            assert drainer.result(timeout=10) is True
+
+
+class TestMetricsContract:
+    def test_histograms_observe_every_served_request_exactly_once(self):
+        metrics = Metrics()
+        model = RecordingModel()
+        s = make_server(model, max_batch_size=4, batch_timeout_s=0.01,
+                        queue_limit=64, metrics=metrics,
+                        labels={"serve": "t"})
+        n = 23
+        with ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(s.submit, ("a", i)) for i in range(n)]
+            for f in futs:
+                f.result(timeout=10)
+        assert s.drain()
+        snap = metrics.snapshot()
+        for fam in ("tfk8s_serving_queue_seconds",
+                    "tfk8s_serving_execute_seconds",
+                    "tfk8s_serving_request_seconds"):
+            counts = [
+                v["count"] for k, v in snap["histograms"].items()
+                if k.startswith(fam)
+            ]
+            assert sum(counts) == n, (fam, snap["histograms"])
+        ok = [
+            v for k, v in snap["counters"].items()
+            if "requests_total" in k and 'outcome="ok"' in k
+        ]
+        assert sum(ok) == n
+
+    def test_shed_requests_are_counted_but_never_observed(self):
+        metrics = Metrics()
+        gate = threading.Event()
+        model = RecordingModel(gate)
+        s = make_server(model, max_batch_size=1, batch_timeout_s=0.0,
+                        queue_limit=1, metrics=metrics)
+        with ThreadPoolExecutor(4) as ex:
+            first = ex.submit(s.submit, ("a", 0))
+            time.sleep(0.05)
+            second = ex.submit(s.submit, ("a", 1))
+            time.sleep(0.05)
+            with pytest.raises(Overloaded):
+                s.submit(("a", 2))
+            gate.set()
+            first.result(timeout=10), second.result(timeout=10)
+        assert s.drain()
+        snap = metrics.snapshot()
+        total_observed = sum(
+            v["count"] for k, v in snap["histograms"].items()
+            if k.startswith("tfk8s_serving_request_seconds")
+        )
+        assert total_observed == 2  # the served ones; the shed one never
+
+    def test_model_failure_fans_out_and_counts_errors(self):
+        metrics = Metrics()
+        model = RecordingModel()
+        model.fail_batches = 1
+        s = make_server(model, max_batch_size=2, batch_timeout_s=0.05,
+                        metrics=metrics)
+        with ThreadPoolExecutor(2) as ex:
+            futs = [ex.submit(s.submit, ("a", i)) for i in range(2)]
+            errs = 0
+            for f in futs:
+                try:
+                    f.result(timeout=10)
+                except RequestFailed:
+                    errs += 1
+        assert errs == 2
+        snap = metrics.snapshot()
+        err_counts = [
+            v for k, v in snap["counters"].items()
+            if "requests_total" in k and 'outcome="error"' in k
+        ]
+        assert sum(err_counts) == 2
+        # failed requests are not observed in the latency histograms
+        assert not any(
+            k.startswith("tfk8s_serving_request_seconds")
+            for k in snap["histograms"]
+        )
+        # the server survives: the next request serves normally
+        assert s.submit(("a", 7), timeout=10) == ("ok", ("a", 7))
+        assert s.drain()
+
+
+class TestOccupancy:
+    def test_mean_batch_occupancy_tracks_batches(self):
+        model = EchoModel("v", delay_ms=5)
+        model.load()
+        s = make_server(model, max_batch_size=8, batch_timeout_s=0.02,
+                        queue_limit=128)
+        with ThreadPoolExecutor(16) as ex:
+            futs = [ex.submit(s.submit, float(i)) for i in range(64)]
+            for f in futs:
+                f.result(timeout=30)
+        assert s.served_total == 64
+        assert s.mean_batch_occupancy > 1.0, (
+            "concurrent load against a 5ms model must batch"
+        )
+        report = s.report_progress()
+        assert report["serving_ready"] == 1.0
+        assert report["serving_batch_occupancy"] == s.mean_batch_occupancy
+        assert s.drain()

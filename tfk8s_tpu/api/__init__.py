@@ -5,6 +5,8 @@ C4-C9; images/tf3.PNG at k8s-operator.md:229).
 """
 
 from tfk8s_tpu.api.types import (  # noqa: F401
+    AutoscalePolicy,
+    BatchingPolicy,
     CleanPodPolicy,
     Condition,
     ContainerSpec,
@@ -20,17 +22,29 @@ from tfk8s_tpu.api.types import (  # noqa: F401
     ReplicaStatus,
     ReplicaType,
     RestartPolicy,
+    RollingUpdatePolicy,
     RunPolicy,
     SchedulingPolicy,
+    ServeCondition,
+    ServeConditionType,
     Service,
     ServicePort,
     ServiceSpec,
     TPUJob,
     TPUJobSpec,
     TPUJobStatus,
+    TPUServe,
+    TPUServeSpec,
+    TPUServeStatus,
     TPUSpec,
 )
-from tfk8s_tpu.api.defaults import set_defaults  # noqa: F401
-from tfk8s_tpu.api.validation import ValidationError, validate, validate_or_raise  # noqa: F401
+from tfk8s_tpu.api.defaults import set_defaults, set_serve_defaults  # noqa: F401
+from tfk8s_tpu.api.validation import (  # noqa: F401
+    ValidationError,
+    validate,
+    validate_or_raise,
+    validate_serve,
+    validate_serve_or_raise,
+)
 from tfk8s_tpu.api import helpers  # noqa: F401
 from tfk8s_tpu.api import serde  # noqa: F401

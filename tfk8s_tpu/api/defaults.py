@@ -16,12 +16,17 @@ from tfk8s_tpu.api.types import (
     ReplicaType,
     RestartPolicy,
     TPUJob,
+    TPUServe,
 )
 from tfk8s_tpu.utils import topology as topo
 
 DEFAULT_ACCELERATOR = "cpu-1"
 DEFAULT_MAX_RESTARTS = 3
 DEFAULT_BACKOFF_LIMIT = 3
+
+# The in-process model server (runtime/server.py): what a TPUServe pod
+# runs unless the template pins another entrypoint.
+DEFAULT_SERVE_ENTRYPOINT = "tfk8s_tpu.runtime.server:serve"
 
 
 def set_defaults(job: TPUJob) -> TPUJob:
@@ -58,3 +63,19 @@ def set_defaults(job: TPUJob) -> TPUJob:
             pass  # malformed accelerator -> leave unset; validation reports it
 
     return job
+
+
+def set_serve_defaults(serve: TPUServe) -> TPUServe:
+    """Fill unset TPUServe spec fields in place and return it. Idempotent,
+    like :func:`set_defaults`."""
+    spec = serve.spec
+    if not spec.template.entrypoint and not spec.template.image:
+        spec.template.entrypoint = DEFAULT_SERVE_ENTRYPOINT
+    if not spec.tpu.accelerator:
+        spec.tpu.accelerator = DEFAULT_ACCELERATOR
+    auto = spec.autoscale
+    if auto.enabled:
+        # the autoscaler owns replicas between its bounds; a spec count
+        # outside them is clamped rather than rejected (HPA semantics)
+        spec.replicas = min(max(spec.replicas, auto.min_replicas), auto.max_replicas)
+    return serve

@@ -276,6 +276,124 @@ class TPUJobStatus:
 
 
 # ---------------------------------------------------------------------------
+# Serving (TPUServe): the inference workload — a replicated, dynamically
+# batched model server with rolling updates and a queue-depth autoscaler.
+# The training CRD reconciles a *gang* (all-or-nothing, fails as a unit);
+# serving replicas are deliberately independent: each holds its own model
+# copy, so the controller can surge/drain them one at a time.
+# ---------------------------------------------------------------------------
+
+
+class ServeConditionType(str, enum.Enum):
+    AVAILABLE = "Available"    # ready replicas >= spec.replicas, all updated
+    PROGRESSING = "Progressing"  # a rollout or scale is converging
+    DEGRADED = "Degraded"      # validation failed / replicas crash-looping
+
+
+@dataclass
+class ServeCondition:
+    type: ServeConditionType
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=time.time, metadata=RFC3339)
+
+
+@dataclass
+class BatchingPolicy:
+    """Dynamic micro-batching knobs (runtime/server.py): a batch closes at
+    ``max_batch_size`` or after ``batch_timeout_ms`` — whichever first —
+    and the request queue is bounded at ``queue_limit``; past it, submits
+    shed with the typed overload error instead of queuing unboundedly
+    (Clipper-style adaptive batching under a latency SLO)."""
+
+    max_batch_size: int = 8
+    batch_timeout_ms: float = 10.0
+    queue_limit: int = 128
+
+
+@dataclass
+class RollingUpdatePolicy:
+    """Deployment-style surge rollout: during an update at most
+    ``max_surge`` replicas exist above ``spec.replicas``, and the count of
+    READY replicas never drops below ``replicas - max_unavailable`` (old
+    replicas drain before deletion, gated on new ones passing readiness)."""
+
+    max_surge: int = 1
+    max_unavailable: int = 0
+
+
+@dataclass
+class AutoscalePolicy:
+    """Queue-depth autoscaling: the controller smooths the replicas'
+    reported queue depth (EMA) and sizes ``replicas`` to hold the
+    per-replica depth near ``target_queue_depth``. Hysteresis bands
+    (scale up only above ``target * high_band``, down only below
+    ``target * low_band``) plus ``cooldown_s`` between scale events keep
+    it from flapping."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_queue_depth: float = 4.0
+    high_band: float = 1.25
+    low_band: float = 0.5
+    cooldown_s: float = 30.0
+
+
+@dataclass
+class TPUServeSpec:
+    """What to serve and how. ``task`` names a registered served-model
+    family (runtime/server.py: ``echo`` / ``mlp`` / ``gpt``);
+    ``checkpoint`` is the model-weights ref the server loads before
+    reporting Ready (``seed:<n>`` for hermetic deterministic params, or a
+    checkpoint directory/URI). Changing ``checkpoint`` (or the template /
+    batching) changes the pod-template hash and triggers a rolling
+    update."""
+
+    task: str = ""
+    checkpoint: str = ""
+    replicas: int = 1
+    # image/env parity with training replicas; entrypoint defaults to the
+    # in-process model server (runtime/server.py:serve)
+    template: ContainerSpec = field(default_factory=ContainerSpec)
+    batching: BatchingPolicy = field(default_factory=BatchingPolicy)
+    rolling_update: RollingUpdatePolicy = field(default_factory=RollingUpdatePolicy)
+    autoscale: AutoscalePolicy = field(default_factory=AutoscalePolicy)
+    tpu: TPUSpec = field(default_factory=TPUSpec)
+
+
+@dataclass
+class TPUServeStatus:
+    conditions: List[ServeCondition] = field(default_factory=list)
+    # live (non-terminal, non-deleting) serving pods observed
+    replicas: int = 0
+    # live pods that loaded the checkpoint and passed the health probe
+    ready_replicas: int = 0
+    # live pods rendered from the CURRENT pod-template hash
+    updated_replicas: int = 0
+    # the template hash fully rolled out (== desired once a rollout ends)
+    observed_version: str = ""
+    # smoothed load signals the autoscaler acts on (mirrored from the
+    # replicas' per-pod reports)
+    queue_depth: float = 0.0
+    qps: float = 0.0
+    last_scale_time: Optional[float] = field(default=None, metadata=RFC3339)
+
+
+@dataclass
+class TPUServe:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUServeSpec = field(default_factory=TPUServeSpec)
+    status: TPUServeStatus = field(default_factory=TPUServeStatus)
+    api_version: str = API_VERSION
+    kind: str = "TPUServe"
+
+    def deepcopy(self) -> "TPUServe":
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
 # Top-level objects
 # ---------------------------------------------------------------------------
 
@@ -401,6 +519,7 @@ class Event:
 # All registerable top-level kinds, for the scheme (serde.py).
 TOP_LEVEL_KINDS = {
     "TPUJob": TPUJob,
+    "TPUServe": TPUServe,
     "Pod": Pod,
     "Service": Service,
     "Lease": Lease,

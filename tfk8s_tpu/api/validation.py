@@ -8,7 +8,7 @@ from __future__ import annotations
 import re
 from typing import List
 
-from tfk8s_tpu.api.types import ReplicaType, TPUJob
+from tfk8s_tpu.api.types import ReplicaType, TPUJob, TPUServe
 from tfk8s_tpu.utils import topology as topo
 
 # DNS-1123 label: what k8s accepts for object names.
@@ -120,5 +120,91 @@ def validate(job: TPUJob) -> List[str]:
 
 def validate_or_raise(job: TPUJob) -> None:
     errs = validate(job)
+    if errs:
+        raise ValidationError(errs)
+
+
+def validate_serve(serve: TPUServe) -> List[str]:
+    """Validate a (defaulted) TPUServe; empty list means valid."""
+    errs: List[str] = []
+    meta, spec = serve.metadata, serve.spec
+
+    if not meta.name:
+        errs.append("metadata.name: required")
+    elif len(meta.name) > MAX_NAME_LEN or not _NAME_RE.match(meta.name):
+        errs.append(
+            f"metadata.name: {meta.name!r} must be a DNS-1123 label "
+            f"(<= {MAX_NAME_LEN} chars, [a-z0-9-])"
+        )
+    if not meta.namespace:
+        errs.append("metadata.namespace: required")
+
+    if not spec.task:
+        errs.append("spec.task: required (a registered served-model family)")
+    if spec.replicas < 0:
+        errs.append(f"spec.replicas: must be >= 0, got {spec.replicas}")
+    if not spec.template.entrypoint and not spec.template.image:
+        errs.append("spec.template: entrypoint or image is required")
+
+    b = spec.batching
+    if b.max_batch_size < 1:
+        errs.append(f"spec.batching.maxBatchSize: must be >= 1, got {b.max_batch_size}")
+    if b.batch_timeout_ms < 0:
+        errs.append(
+            f"spec.batching.batchTimeoutMs: must be >= 0, got {b.batch_timeout_ms}"
+        )
+    if b.queue_limit < b.max_batch_size:
+        errs.append(
+            f"spec.batching.queueLimit: must be >= maxBatchSize "
+            f"({b.max_batch_size}), got {b.queue_limit}"
+        )
+
+    ru = spec.rolling_update
+    if ru.max_surge < 0 or ru.max_unavailable < 0:
+        errs.append("spec.rollingUpdate: maxSurge and maxUnavailable must be >= 0")
+    if ru.max_surge == 0 and ru.max_unavailable == 0:
+        errs.append(
+            "spec.rollingUpdate: maxSurge and maxUnavailable cannot both be 0 "
+            "(no replica could ever be replaced)"
+        )
+
+    a = spec.autoscale
+    if a.enabled:
+        if a.min_replicas < 1:
+            # scale-to-zero would be a one-way door: the scale-up signal
+            # is the replicas' own queue-depth reports, and zero replicas
+            # report nothing — an external activator (not built) is the
+            # prerequisite for min 0
+            errs.append(f"spec.autoscale.minReplicas: must be >= 1, got {a.min_replicas}")
+        if a.max_replicas < max(a.min_replicas, 1):
+            errs.append(
+                f"spec.autoscale.maxReplicas: must be >= max(minReplicas, 1), "
+                f"got {a.max_replicas}"
+            )
+        if a.target_queue_depth <= 0:
+            errs.append(
+                f"spec.autoscale.targetQueueDepth: must be > 0, got "
+                f"{a.target_queue_depth}"
+            )
+        if not (a.low_band < 1.0 <= a.high_band):
+            errs.append(
+                "spec.autoscale: need lowBand < 1.0 <= highBand "
+                f"(got low={a.low_band}, high={a.high_band}) — overlapping "
+                "bands would oscillate"
+            )
+        if a.cooldown_s < 0:
+            errs.append(f"spec.autoscale.cooldownS: must be >= 0, got {a.cooldown_s}")
+
+    if spec.tpu.accelerator:
+        try:
+            topo.parse_accelerator(spec.tpu.accelerator, spec.tpu.topology)
+        except topo.TopologyError as e:
+            errs.append(f"spec.tpu: {e}")
+
+    return errs
+
+
+def validate_serve_or_raise(serve: TPUServe) -> None:
+    errs = validate_serve(serve)
     if errs:
         raise ValidationError(errs)

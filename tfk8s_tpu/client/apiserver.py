@@ -78,6 +78,7 @@ log = get_logger("apiserver")
 # k8s-operator.md:20-27)
 PLURALS: Dict[str, str] = {
     "tpujobs": "TPUJob",
+    "tpuserves": "TPUServe",
     "pods": "Pod",
     "services": "Service",
     "leases": "Lease",
@@ -383,17 +384,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_store_error(e)
 
     def _admit(self, obj) -> None:
-        """Admission for TPUJob writes (the CRD webhook's job, done by the
-        API machinery here): apply defaults, then validate — invalid specs
-        are rejected at the boundary with 422 Invalid, like a validating
-        webhook, instead of being persisted and later failed by the
-        controller. Raises :class:`_AdmissionRejected` on invalid specs."""
-        if obj.kind != "TPUJob" or not self.server.admission:
+        """Admission for CRD writes (the validating webhook's job, done by
+        the API machinery here): apply defaults, then validate — invalid
+        specs are rejected at the boundary with 422 Invalid instead of
+        being persisted and later failed by the controller. Raises
+        :class:`_AdmissionRejected` on invalid specs."""
+        if not self.server.admission:
             return
-        from tfk8s_tpu.api import set_defaults, validate
+        if obj.kind == "TPUJob":
+            from tfk8s_tpu.api import set_defaults, validate
 
-        set_defaults(obj)
-        errs = validate(obj)
+            set_defaults(obj)
+            errs = validate(obj)
+        elif obj.kind == "TPUServe":
+            from tfk8s_tpu.api import set_serve_defaults, validate_serve
+
+            set_serve_defaults(obj)
+            errs = validate_serve(obj)
+        else:
+            return
         if errs:
             raise _AdmissionRejected("; ".join(errs))
 
@@ -561,14 +570,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.server.store.stop_watch(w)
 
 
-def _parse_selector(raw: str) -> Dict[str, str]:
-    """``a=b,c=d`` → dict (the labelSelector query format)."""
+def parse_selector(raw: str) -> Dict[str, str]:
+    """``a=b,c=d`` → dict (the labelSelector query format). The ONE
+    parser — the CLI's ``-l`` flag uses it too, so client and server
+    selector semantics cannot drift."""
     out: Dict[str, str] = {}
-    for part in raw.split(","):
+    for part in (raw or "").split(","):
         if "=" in part:
             k, v = part.split("=", 1)
             out[k.strip()] = v.strip()
     return out
+
+
+_parse_selector = parse_selector  # internal alias (pre-rename call sites)
 
 
 class APIServer(ThreadingHTTPServer):

@@ -158,6 +158,9 @@ class Clientset:
     def tpujobs(self, namespace: Optional[str] = "default") -> TypedClient:
         return TypedClient(self._store, "TPUJob", namespace, self._limiter)
 
+    def tpuserves(self, namespace: Optional[str] = "default") -> TypedClient:
+        return TypedClient(self._store, "TPUServe", namespace, self._limiter)
+
     def pods(self, namespace: Optional[str] = "default") -> TypedClient:
         return TypedClient(self._store, "Pod", namespace, self._limiter)
 
@@ -173,5 +176,5 @@ class Clientset:
             "group": GROUP,
             "version": VERSION,
             "api_path": self.config.api_path,
-            "kinds": ["TPUJob", "Pod", "Service"],
+            "kinds": ["TPUJob", "TPUServe", "Pod", "Service"],
         }

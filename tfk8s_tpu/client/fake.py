@@ -55,6 +55,10 @@ class _RecordingClient(TypedClient):
             from tfk8s_tpu.api import set_defaults
 
             set_defaults(obj)
+        elif obj.kind == "TPUServe":
+            from tfk8s_tpu.api import set_serve_defaults
+
+            set_serve_defaults(obj)
         return super()._do_create(obj)
 
     def get(self, name: str) -> Any:
@@ -144,6 +148,9 @@ class FakeClientset(Clientset):
 
     def tpujobs(self, namespace: Optional[str] = "default"):
         return self._client("TPUJob", namespace)
+
+    def tpuserves(self, namespace: Optional[str] = "default"):
+        return self._client("TPUServe", namespace)
 
     def pods(self, namespace: Optional[str] = "default"):
         return self._client("Pod", namespace)

@@ -19,13 +19,20 @@ documents for the real apiserver:
   strips the last finalizer (k8s-operator.md:36-43).
 - **Durability** (``journal_dir``): every mutation appends one JSONL record
   to a write-ahead log before it is acknowledged; a snapshot compacts the
-  log periodically. A restarted store replays snapshot+WAL and resumes the
-  SAME resource_version sequence — the etcd-backed persistence the
-  reference's REST contract presupposes (k8s-operator.md:33-43: deletion
-  timestamps and finalizers only make sense on objects that survive a
-  control-plane restart). Watchers reconnecting from a pre-restart rv that
-  the replayed WAL no longer covers get :class:`Gone` and relist — the
-  same recovery path as a compacted etcd.
+  log periodically. The WAL is **segmented per kind**
+  (``wal-<Kind>.jsonl``): each record carries its resource_version, and
+  replay merges every segment (plus a legacy single-stream ``wal.jsonl``
+  if present) in rv order — so concurrent writers of DIFFERENT kinds
+  serialize+append in parallel under their own kind locks instead of all
+  funnelling one append stream through the store-wide commit lock (the
+  durable-store counterpart of the per-kind-lock read/write split). A
+  restarted store replays snapshot+segments and resumes the SAME
+  resource_version sequence — the etcd-backed persistence the reference's
+  REST contract presupposes (k8s-operator.md:33-43: deletion timestamps
+  and finalizers only make sense on objects that survive a control-plane
+  restart). Watchers reconnecting from a pre-restart rv that the replayed
+  WAL no longer covers get :class:`Gone` and relist — the same recovery
+  path as a compacted etcd.
 
 **Copy-on-write** (client-go's shared-informer discipline, enforced via
 ``api/frozen.py``): every stored object is FROZEN once at the write
@@ -138,6 +145,13 @@ class WatchEvent:
 # (the slow-watcher policy below) so one stalled consumer's backlog is
 # bounded by the number of DISTINCT live objects, not by event rate.
 DEFAULT_WATCH_QUEUE = 1024
+
+# Compaction normally runs opportunistically when a commit applies with no
+# other commit in its journal window (_inflight == 0). Under sustained
+# overlapping writes that moment may never come; once the WAL reaches this
+# multiple of compact_every, new commits stall until the in-flight set
+# drains and the compaction runs, bounding WAL growth.
+FORCE_COMPACT_FACTOR = 2
 
 
 def _coalesce_type(pending: EventType, new: EventType) -> EventType:
@@ -265,6 +279,60 @@ class Watch:
             return out
 
 
+class _Segment:
+    """One kind's WAL segment file. Appends are serialized by a private
+    IO mutex (NOT the kind lock — compaction must be able to truncate a
+    segment it couldn't take the kind lock for without deadlocking the
+    kind→commit lock order). A failed append rolls the file back to its
+    last good byte (the write-AHEAD contract: nothing half-written may
+    survive to fuse with the next record)."""
+
+    def __init__(self, path: str, fsync: bool):
+        self.path = path
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def append(self, line: bytes) -> None:
+        """Append one complete record line, or raise leaving the file
+        byte-identical to its pre-call state. OSError on unrecoverable
+        rollback failure carries ``.rollback_failed = True``."""
+        with self._lock:
+            start = self._f.tell()
+            try:
+                self._f.write(line)
+                self._f.flush()
+                if self._fsync:
+                    os.fsync(self._f.fileno())
+            # ValueError = closed handle (an append racing close() past
+            # the _closed check): same rollback treatment — the on-disk
+            # bytes are already consistent, the reopen restores a handle
+            except (OSError, ValueError) as e:
+                try:
+                    self._f.close()  # may raise re-flushing; superseded below
+                except OSError:
+                    pass
+                try:
+                    with open(self.path, "ab") as fix:
+                        fix.truncate(start)
+                    self._f = open(self.path, "ab")
+                except OSError:
+                    e.rollback_failed = True
+                raise
+
+    def truncate(self) -> None:
+        with self._lock:
+            self._f.truncate(0)
+            self._f.seek(0)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
 def _key(namespace: str, name: str) -> str:
     return f"{namespace}/{name}"
 
@@ -325,10 +393,13 @@ class ClusterStore:
     """Thread-safe object store keyed by (kind, namespace/name).
 
     With ``journal_dir`` set, the store is durable: ``snapshot.json`` holds
-    a compacted full state, ``wal.jsonl`` the event log since; construction
-    replays both and resumes the rv sequence. ``fsync=False`` trades
-    power-loss durability for write latency (kill -9 survival only needs
-    the page cache, so tests and the control-plane bench may disable it).
+    a compacted full state, per-kind ``wal-<Kind>.jsonl`` segments the
+    event log since; construction replays snapshot + segments (merged by
+    rv; a legacy single-stream ``wal.jsonl`` is honored and retired at
+    the next compaction) and resumes the rv sequence. ``fsync=False``
+    trades power-loss durability for write latency (kill -9 survival only
+    needs the page cache, so tests and the control-plane bench may
+    disable it).
 
     Read contract (copy-on-write, module docstring): ``get``/``list``
     return the SHARED frozen stored instance; mutating it raises
@@ -368,9 +439,30 @@ class ClusterStore:
         self._fsync = fsync
         self._metrics = metrics
         self._watch_queue_limit = watch_queue_limit
-        self._wal = None  # append handle on wal.jsonl
-        self._wal_records = 0
+        # per-kind WAL segments (wal-<Kind>.jsonl), opened lazily on the
+        # kind's first journaled write; replay merges them all by rv
+        self._segments: Dict[str, _Segment] = {}
+        self._wal_records = 0  # total records across all segments
+        # commits between rv-assign and bucket-apply: compaction must not
+        # run (and truncate a journaled-but-unapplied record) while any
+        # are in flight
+        self._inflight = 0
+        # Set when the WAL outgrows FORCE_COMPACT_FACTOR x compact_every
+        # while commits kept overlapping (the opportunistic
+        # ``_inflight == 0`` check alone can starve forever under
+        # sustained concurrent multi-kind writes). New commits then stall
+        # at rv-assign until the last in-flight commit compacts, so WAL
+        # growth is bounded at ~FORCE_COMPACT_FACTOR x the threshold.
+        self._compact_pending = False
+        self._compact_cv = threading.Condition(self._lock)
+        # events at/below this rv are unreplayable (compacted away before
+        # this process started); watchers older than it must relist
+        self._base_rv = 0
         self._poisoned = False
+        # close() flips this (under the commit lock): later writes skip
+        # journaling instead of lazily re-opening a segment past close —
+        # the pre-segment `_wal = None` semantics
+        self._closed = False
         if metrics is not None:
             metrics.describe(
                 "tfk8s_watch_coalesced_total",
@@ -394,76 +486,119 @@ class ClusterStore:
     def _snapshot_path(self) -> str:
         return os.path.join(self._journal_dir, "snapshot.json")
 
+    # single-stream WAL from pre-segment builds: replayed (merged by rv)
+    # and removed at the next compaction
     @property
-    def _wal_path(self) -> str:
+    def _legacy_wal_path(self) -> str:
         return os.path.join(self._journal_dir, "wal.jsonl")
 
+    def _segment_path(self, kind: str) -> str:
+        return os.path.join(self._journal_dir, f"wal-{kind}.jsonl")
+
+    def _segment_paths_on_disk(self) -> List[str]:
+        out = []
+        for n in sorted(os.listdir(self._journal_dir)):
+            if n == "wal.jsonl" or (n.startswith("wal-") and n.endswith(".jsonl")):
+                out.append(os.path.join(self._journal_dir, n))
+        return out
+
+    def _read_segment(self, path: str) -> List[Tuple[int, EventType, Any]]:
+        """Parse one WAL file into (rv, type, frozen obj) records. A torn
+        FINAL line (kill -9 mid-write) is truncated away — everything
+        before it was acknowledged with a complete line, so nothing acked
+        is lost. A COMPLETE line that fails to decode is mid-file
+        corruption (or a schema break); acked records may follow it, so
+        refuse to start instead of truncating them away (etcd does the
+        same)."""
+        from tfk8s_tpu.api import serde  # api layer; no import cycle
+
+        records: List[Tuple[int, EventType, Any]] = []
+        good_end = 0
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    log.warning(
+                        "journal: truncating torn WAL tail of %s (%d bytes)",
+                        os.path.basename(path), len(line),
+                    )
+                    break
+                try:
+                    rec = json.loads(line)
+                    obj = freeze(serde.decode_object(rec["obj"]))
+                    records.append((rec["rv"], EventType(rec["type"]), obj))
+                except (ValueError, KeyError) as e:
+                    raise JournalCorrupt(
+                        f"{path} byte {good_end}: undecodable complete "
+                        f"record: {e}"
+                    ) from e
+                good_end += len(line)
+        with open(path, "ab") as fix:  # drop the torn tail on disk too
+            fix.truncate(good_end)
+        return records
+
     def _open_journal(self) -> None:
-        """Replay snapshot + WAL, then open the WAL for append. A torn final
-        line (kill -9 mid-write) is truncated away — everything before it
-        was acknowledged with a complete line, so nothing acked is lost."""
+        """Replay snapshot + every WAL segment (merged by rv), then open
+        segments for append lazily. Records at/below the snapshot rv are
+        skipped — a legacy or straggler file can never roll applied state
+        backwards."""
         from tfk8s_tpu.api import serde  # api layer; no import cycle
 
         os.makedirs(self._journal_dir, exist_ok=True)
+        snap_rv = 0
         if os.path.exists(self._snapshot_path):
             with open(self._snapshot_path) as f:
                 snap = json.load(f)
-            self._last_rv = snap["rv"]
+            snap_rv = snap["rv"]
+            self._last_rv = snap_rv
             for data in snap["objects"]:
                 obj = freeze(serde.decode_object(data))
                 self._bucket(obj.kind)[obj.metadata.key] = obj
-        good_end = 0
-        if os.path.exists(self._wal_path):
-            with open(self._wal_path, "rb") as f:
-                for line in f:
-                    if not line.endswith(b"\n"):
-                        # A torn tail is the expected kill -9 artifact: the
-                        # record was never acked (ack follows the full-line
-                        # write), so truncating exactly it loses nothing.
-                        log.warning(
-                            "journal: truncating torn WAL tail (%d bytes)", len(line)
-                        )
-                        break
-                    try:
-                        rec = json.loads(line)
-                        obj = freeze(serde.decode_object(rec["obj"]))
-                        etype = EventType(rec["type"])
-                    except (ValueError, KeyError) as e:
-                        # A COMPLETE line that fails to decode is mid-file
-                        # corruption (or a schema break). Acked records may
-                        # follow it — truncating here would destroy them, so
-                        # refuse to start instead (etcd does the same).
-                        raise JournalCorrupt(
-                            f"{self._wal_path} byte {good_end}: "
-                            f"undecodable complete record: {e}"
-                        ) from e
-                    bucket = self._bucket(obj.kind)
-                    if etype == EventType.DELETED:
-                        bucket.pop(obj.metadata.key, None)
-                    else:
-                        bucket[obj.metadata.key] = obj
-                    self._last_rv = max(self._last_rv, rec["rv"])
-                    self._history.append((rec["rv"], WatchEvent(etype, obj)))
-                    self._wal_records += 1
-                    good_end += len(line)
+        self._base_rv = snap_rv
+        records: List[Tuple[int, EventType, Any]] = []
+        for path in self._segment_paths_on_disk():
+            records.extend(self._read_segment(path))
+        records.sort(key=lambda r: r[0])
+        for rv, etype, obj in records:
+            if rv <= snap_rv:
+                continue  # already folded into the snapshot
+            bucket = self._bucket(obj.kind)
+            if etype == EventType.DELETED:
+                bucket.pop(obj.metadata.key, None)
+            else:
+                bucket[obj.metadata.key] = obj
+            self._last_rv = max(self._last_rv, rv)
+            self._history.append((rv, WatchEvent(etype, obj)))
+            self._wal_records += 1
         self._rv = itertools.count(self._last_rv + 1)
-        self._wal = open(self._wal_path, "ab")
-        if good_end != self._wal.tell():
-            self._wal.truncate(good_end)
-            self._wal.seek(good_end)
+
+    def _segment(self, kind: str) -> _Segment:
+        seg = self._segments.get(kind)
+        if seg is None:
+            with self._lock:
+                if self._closed:
+                    # a commit that captured journaling=True just before
+                    # close() must fail loudly, not lazily re-create a
+                    # segment file in a directory the owner believes dead
+                    raise StoreError("store closed; refusing journal append")
+                seg = self._segments.get(kind)
+                if seg is None:
+                    seg = _Segment(self._segment_path(kind), self._fsync)
+                    self._segments[kind] = seg
+        return seg
 
     def _journal(self, etype: EventType, obj: Any) -> None:
-        """Append one event record; called under the lock, BEFORE watchers
-        see the event, so nothing observable ever precedes the WAL.
+        """Append one event record to the object's KIND segment — called
+        under the kind lock (not the store-wide commit lock), BEFORE the
+        mutation is applied or fanned out, so nothing observable ever
+        precedes the WAL. Per-kind segments mean two kinds' writers
+        serialize and append concurrently; within a segment rv order holds
+        because the kind lock covers the whole write.
 
-        A failed append must leave the WAL byte-identical to its last good
-        state: a BufferedWriter that kept (or half-wrote) the failed
-        record's bytes would prepend them to the NEXT successful append —
-        either resurrecting a never-acked object after restart or fusing
-        two lines into one undecodable record (JournalCorrupt on the next
-        start). If even the rollback fails, the journal is poisoned and
-        every further mutation is refused — availability is the right
-        thing to sacrifice for a store whose point is durability."""
+        A failed append leaves the segment byte-identical to its last good
+        state (see :class:`_Segment`). If even the rollback fails, the
+        journal is poisoned and every further mutation is refused —
+        availability is the right thing to sacrifice for a store whose
+        point is durability."""
         from tfk8s_tpu.api import serde
 
         if self._poisoned:
@@ -476,46 +611,35 @@ class ClusterStore:
             "type": etype.value,
             "obj": serde.to_dict(obj),
         }
-        start = self._wal.tell()
         try:
-            self._wal.write((json.dumps(rec) + "\n").encode())
-            self._wal.flush()
-            if self._fsync:
-                os.fsync(self._wal.fileno())
-        except OSError:
-            try:
-                self._wal.close()  # may raise re-flushing; superseded below
-            except OSError:
-                pass
-            try:
-                with open(self._wal_path, "ab") as fix:
-                    fix.truncate(start)
-                self._wal = open(self._wal_path, "ab")
-            except OSError:
+            self._segment(obj.kind).append((json.dumps(rec) + "\n").encode())
+        except (OSError, ValueError) as e:
+            if getattr(e, "rollback_failed", False):
                 self._poisoned = True
                 log.error(
                     "journal: could not roll back failed append; poisoning "
-                    "the store (WAL intact through rv %d)", self._last_rv,
+                    "the store (segments intact through rv %d)", self._last_rv,
                 )
             raise
-        self._wal_records += 1
+        with self._lock:
+            self._wal_records += 1
 
     def _compact(self) -> None:
-        """Atomic snapshot of full state, then truncate the WAL. Watchers
-        holding pre-snapshot rvs will relist via Gone after a restart —
-        exactly etcd compaction semantics.
+        """Atomic snapshot of full state, then truncate every segment (and
+        drop a legacy single-stream WAL). Watchers holding pre-snapshot
+        rvs will relist via Gone after a restart — exactly etcd compaction
+        semantics.
 
         Ordering matters: the snapshot (and, under fsync, its directory
-        entry) must be durable BEFORE the WAL is truncated, or a power cut
-        between the two could leave the old snapshot + an empty WAL —
-        losing everything since the previous compaction.
+        entry) must be durable BEFORE any segment is truncated, or a power
+        cut between the two could leave the old snapshot + empty segments
+        — losing everything since the previous compaction.
 
-        Runs synchronously under the store lock — a deliberate tradeoff:
-        at this store's scale (thousands of objects) the pause is
-        single-digit ms every ``compact_every`` writes; a background
-        compactor would need WAL segment rotation for no measured win
-        (the control-plane bench rides this path).
-        """
+        Runs synchronously under the store lock with ``_inflight == 0``
+        (enforced by the caller): a commit that journaled but has not yet
+        applied would otherwise have its record truncated while missing
+        from the snapshot — an acked-write hole. The pause is single-digit
+        ms at this store's scale every ``compact_every`` writes."""
         from tfk8s_tpu.api import serde
 
         snap = {
@@ -534,23 +658,36 @@ class ClusterStore:
                 os.fsync(f.fileno())
         os.replace(tmp, self._snapshot_path)
         if self._fsync:
-            # persist the rename itself before dropping the WAL
+            # persist the rename itself before dropping the segments
             dir_fd = os.open(self._journal_dir, os.O_RDONLY)
             try:
                 os.fsync(dir_fd)
             finally:
                 os.close(dir_fd)
-        # truncate through the live handle — no close/reopen window in
+        # truncate through the live handles — no close/reopen window in
         # which a failure could leave the store without a WAL handle
-        self._wal.truncate(0)
-        self._wal.seek(0)
+        for seg in self._segments.values():
+            seg.truncate()
+        # stale on-disk files with no live handle (a kind not written
+        # since restart, or the legacy single-stream WAL): their records
+        # are all <= the snapshot rv now — remove them so replay never
+        # re-reads them
+        open_paths = {seg.path for seg in self._segments.values()}
+        for path in self._segment_paths_on_disk():
+            if path not in open_paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass  # replay skips <=snapshot-rv records anyway
         self._wal_records = 0
 
     def close(self) -> None:
         with self._lock:
-            if self._wal is not None:
-                self._wal.close()
-                self._wal = None
+            self._closed = True
+            for seg in self._segments.values():
+                seg.close()  # takes each segment's IO mutex: in-flight
+                # appends finish before their handle closes
+            self._segments = {}
 
     # -- internals ----------------------------------------------------------
 
@@ -558,35 +695,92 @@ class ClusterStore:
         self._last_rv = next(self._rv)
         return self._last_rv
 
+    def _insert_history(self, rv: int, ev: WatchEvent) -> None:
+        """Keep the replay ring rv-ascending. Commits of DIFFERENT kinds
+        can reach the apply step out of rv order (rv assignment and apply
+        are separate commit-lock sections, with the kind-parallel journal
+        append between them); a short bubble from the tail restores order.
+        Called under the commit lock."""
+        h = self._history
+        h.append((rv, ev))
+        i = len(h) - 1
+        while i > 0 and h[i - 1][0] > rv:
+            h[i - 1], h[i] = h[i], h[i - 1]
+            i -= 1
+
     def _commit(self, etype: EventType, stored: Any, apply) -> Any:
-        """The write barrier: assign the rv, FREEZE the object (the one
-        structural walk per write — every read after this shares the
-        frozen instance), journal, apply the bucket mutation, fan out.
-        Called under the object's kind lock; takes the store-wide commit
-        lock for the ordered part. Journal-before-apply keeps the log
-        write-AHEAD: a failed append (ENOSPC, dead disk) raises to the
-        client with NO state change, so readers can never observe an
-        object that a restart would forget. Returns the frozen stored
-        object."""
+        """The write barrier: assign the rv (commit lock), FREEZE the
+        object (the one structural walk per write — every read after this
+        shares the frozen instance), journal to the kind's WAL segment
+        (kind-parallel: only the kind lock is held), then apply the bucket
+        mutation + history + watch fanout (commit lock again).
+        Journal-before-apply keeps the log write-AHEAD: a failed append
+        (ENOSPC, dead disk) raises to the client with NO state change, so
+        readers can never observe an object that a restart would forget.
+        Returns the frozen stored object."""
         with self._lock:
+            # a forced compaction is waiting for in-flight commits to
+            # drain: don't start a new journal window until it has run
+            # (in-flight commits themselves never wait here, so the
+            # drain — and this stall — is bounded)
+            while self._compact_pending:
+                self._compact_cv.wait()
+            journaling = self._journal_dir is not None and not self._closed
             stored.metadata.resource_version = self._bump()
-            frozen_obj = freeze(stored)
-            ev = WatchEvent(etype, frozen_obj)
-            if self._wal is not None:
+            if journaling:
+                self._inflight += 1
+        frozen_obj = freeze(stored)
+        if journaling:
+            try:
                 self._journal(etype, frozen_obj)
+            except BaseException:
+                with self._lock:
+                    self._inflight -= 1
+                    if self._inflight == 0 and self._compact_pending:
+                        # the commit this forced compaction was waiting on
+                        # failed its append: unstall writers; the next
+                        # successful write re-triggers compaction
+                        # (_wal_records is still over threshold)
+                        self._compact_pending = False
+                        self._compact_cv.notify_all()
+                raise
+        with self._lock:
             apply()
-            # compact only AFTER the mutation is applied — a snapshot
-            # taken between journal and apply would miss the in-flight
-            # object and the WAL truncation would then destroy its only
-            # record. A compaction failure must NOT fail the (already
-            # committed and journaled) mutation: log it and retry at the
-            # next write, when _wal_records will still be over threshold.
-            if self._wal is not None and self._wal_records >= self._compact_every:
-                try:
-                    self._compact()
-                except OSError as e:
-                    log.warning("journal: compaction failed (will retry): %s", e)
-            self._history.append((stored.metadata.resource_version, ev))
+            if journaling:
+                self._inflight -= 1
+                # compact only AFTER the mutation is applied, and only
+                # with no other commit mid-flight — a snapshot taken while
+                # a journaled-but-unapplied record exists would miss it
+                # and the truncation would destroy its only copy. A
+                # compaction failure must NOT fail the (already committed
+                # and journaled) mutation: log it and retry at the next
+                # write, when _wal_records will still be over threshold.
+                if self._wal_records >= self._compact_every:
+                    if self._inflight == 0:
+                        try:
+                            self._compact()
+                        except OSError as e:
+                            log.warning(
+                                "journal: compaction failed (will retry): %s",
+                                e,
+                            )
+                        finally:
+                            if self._compact_pending:
+                                self._compact_pending = False
+                                self._compact_cv.notify_all()
+                    elif self._wal_records >= (
+                        self._compact_every * FORCE_COMPACT_FACTOR
+                    ):
+                        # overlapping commits have starved the
+                        # opportunistic check past FORCE_COMPACT_FACTOR x
+                        # the threshold: stall new commits at rv-assign so
+                        # the in-flight set drains; the last one to apply
+                        # takes the _inflight == 0 branch above and
+                        # releases the waiters
+                        self._compact_pending = True
+            self._insert_history(
+                stored.metadata.resource_version, WatchEvent(etype, frozen_obj)
+            )
             kind = frozen_obj.kind
             for wkind, w in self._watchers:
                 if wkind == kind:
@@ -882,11 +1076,17 @@ class ClusterStore:
             )
             if since_rv is not None and since_rv < self._last_rv:
                 oldest_buffered = self._history[0][0] if self._history else None
-                # oldest_buffered None with last_rv > 0 means the store was
-                # restored from a compacted journal — the gap to since_rv is
-                # unreplayable, so the client must relist (410), the same
-                # contract as a compacted etcd.
-                if oldest_buffered is None or since_rv < oldest_buffered - 1:
+                # Unreplayable when the bookmark predates the compaction
+                # floor (_base_rv: events folded into the snapshot before
+                # this process started) or fell off the history ring — the
+                # client must relist (410), the same contract as a
+                # compacted etcd. An empty ring above the floor is NOT
+                # Gone: the only missing events are commits still
+                # mid-flight, and this watcher (registered under the
+                # commit lock) receives them at their fanout.
+                if since_rv < self._base_rv or (
+                    oldest_buffered is not None and since_rv < oldest_buffered - 1
+                ):
                     raise Gone(
                         f"resource_version {since_rv} is too old "
                         f"(oldest buffered: {oldest_buffered})"

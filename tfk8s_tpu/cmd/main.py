@@ -92,22 +92,32 @@ def _build_parser() -> argparse.ArgumentParser:
     p_kl.add_argument("--name", default="kubelet-0",
                       help="node name recorded in pod status")
 
+    # every scheme kind is reachable through the generic verbs; deriving
+    # the choice list from the apiserver's plural table means a newly
+    # registered kind is a one-line change (and the wire-conformance test
+    # fails loudly if the tables ever drift apart)
+    from tfk8s_tpu.client.apiserver import PLURALS
+
+    kind_choices = tuple(sorted(PLURALS))
+
     def kubectlish(name, help_):
         p = sub.add_parser(name, help=help_)
         p.add_argument("--kubeconfig", required=True)
         p.add_argument("-n", "--namespace", default="default")
         return p
 
-    p_sub = kubectlish("submit", "create a TPUJob from a manifest")
+    p_sub = kubectlish("submit", "create an object from a manifest "
+                                 "(any scheme kind: TPUJob, TPUServe, ...)")
     p_sub.add_argument("--file", required=True,
-                       help="TPUJob manifest (YAML or JSON)")
+                       help="manifest (YAML or JSON)")
 
-    p_get = kubectlish("get", "list TPUJobs (or one by name)")
+    p_get = kubectlish("get", "list objects of a kind (or one by name)")
     p_get.add_argument("name", nargs="?", default="")
     p_get.add_argument("-o", "--output", choices=("table", "json"),
                        default="table")
-    p_get.add_argument("--kind", default="tpujobs",
-                       choices=("tpujobs", "pods", "services", "events"))
+    p_get.add_argument("--kind", default="tpujobs", choices=kind_choices)
+    p_get.add_argument("-l", "--selector", default="",
+                       help="label selector, e.g. a=b,c=d")
     p_get.add_argument("-w", "--watch", action="store_true",
                        help="after listing, stream changes (kubectl get -w)")
     p_get.add_argument("--watch-timeout", type=float, default=0.0,
@@ -119,17 +129,18 @@ def _build_parser() -> argparse.ArgumentParser:
     p_patch.add_argument("-p", "--patch", required=True,
                          help='merge patch as JSON, e.g. '
                               '\'{"spec": {"runPolicy": {"suspend": true}}}\'')
-    p_patch.add_argument("--kind", default="tpujobs",
-                         choices=("tpujobs", "pods", "services"))
+    p_patch.add_argument("--kind", default="tpujobs", choices=kind_choices)
     p_patch.add_argument("--subresource", default="",
                          choices=("", "status"),
                          help="patch the status subresource instead")
 
-    p_desc = kubectlish("describe", "full detail of one TPUJob")
+    p_desc = kubectlish("describe", "full detail of one object + its events")
     p_desc.add_argument("name")
+    p_desc.add_argument("--kind", default="tpujobs", choices=kind_choices)
 
-    p_del = kubectlish("delete", "delete a TPUJob (finalizer-honoring)")
+    p_del = kubectlish("delete", "delete an object (finalizer-honoring)")
     p_del.add_argument("name")
+    p_del.add_argument("--kind", default="tpujobs", choices=kind_choices)
 
     p_logs = kubectlish("logs", "print a pod's captured log tail")
     p_logs.add_argument("name", nargs="?", default="",
@@ -437,34 +448,36 @@ def _load_job_for_namespace(args: argparse.Namespace, verb: str):
 
 
 def _cmd_submit(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.client.apiserver import KIND_TO_PLURAL
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
-    job = _load_job_for_namespace(args, "submit")
-    created = cs.tpujobs(job.metadata.namespace).create(job)
-    print(f"tpujob {created.metadata.namespace}/{created.metadata.name} created")
+    obj = _load_job_for_namespace(args, "submit")
+    # generic by the manifest's own kind: `submit --file gpt-serve.yaml`
+    # creates a TPUServe through the same verb
+    created = cs.generic(obj.kind, obj.metadata.namespace).create(obj)
+    singular = KIND_TO_PLURAL.get(created.kind, created.kind.lower() + "s")[:-1]
+    print(f"{singular} {created.metadata.namespace}/{created.metadata.name} created")
     return 0
 
 
 def _cmd_get(args: argparse.Namespace) -> int:
     from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client.apiserver import PLURALS, parse_selector
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
-    client = cs.generic(
-        {"tpujobs": "TPUJob", "pods": "Pod", "services": "Service",
-         "events": "Event"}[args.kind],
-        args.namespace,
-    )
+    client = cs.generic(PLURALS[args.kind], args.namespace)
+    selector = parse_selector(getattr(args, "selector", ""))
     if args.name:
         objs = [client.get(args.name)]
         rv = objs[0].metadata.resource_version
     else:
-        objs, rv = client.list()
+        objs, rv = client.list(label_selector=selector or None)
     if args.output == "json":
         print(json.dumps([serde.to_wire(o) for o in objs], indent=2))
         if getattr(args, "watch", False):
-            return _stream_watch(client, args, rv)
+            return _stream_watch(client, args, rv, selector)
         return 0
     if args.kind == "tpujobs":
         rows = [("NAME", "PHASE", "RESTARTS", "AGE")] + [
@@ -475,6 +488,17 @@ def _cmd_get(args: argparse.Namespace) -> int:
                 _age(j.metadata.creation_timestamp),
             )
             for j in objs
+        ]
+    elif args.kind == "tpuserves":
+        rows = [("NAME", "READY", "UPDATED", "VERSION", "AGE")] + [
+            (
+                s.metadata.name,
+                f"{s.status.ready_replicas}/{s.spec.replicas}",
+                str(s.status.updated_replicas),
+                s.status.observed_version or "-",
+                _age(s.metadata.creation_timestamp),
+            )
+            for s in objs
         ]
     elif args.kind == "events":
         rows = [("LAST SEEN", "REASON", "OBJECT", "COUNT", "MESSAGE")] + [
@@ -501,18 +525,23 @@ def _cmd_get(args: argparse.Namespace) -> int:
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
     if getattr(args, "watch", False):
-        return _stream_watch(client, args, rv)
+        return _stream_watch(client, args, rv, selector)
     return 0
 
 
-def _stream_watch(client, args: argparse.Namespace, since_rv: int) -> int:
+def _stream_watch(
+    client, args: argparse.Namespace, since_rv: int, selector=None
+) -> int:
     """`kubectl get -w` parity: after the initial table, stream one line
     per change event from the apiserver's watch endpoint (the same
     List-then-Watch contract the reflector uses, images/informer1.png)
-    until interrupted or --watch-timeout elapses."""
+    until interrupted or --watch-timeout elapses. The `-l` selector that
+    filtered the table filters the stream too (client-side — the watch
+    endpoint streams the whole kind)."""
     import time as _time
 
     from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client.store import match_labels
 
     def phase_of(o) -> str:
         status = getattr(o, "status", None)
@@ -533,6 +562,8 @@ def _stream_watch(client, args: argparse.Namespace, since_rv: int) -> int:
             if ev.object.metadata.namespace != args.namespace:
                 continue
             if args.name and ev.object.metadata.name != args.name:
+                continue
+            if selector and not match_labels(selector, ev.object.metadata.labels):
                 continue
             if args.output == "json":
                 print(
@@ -557,11 +588,13 @@ def _stream_watch(client, args: argparse.Namespace, since_rv: int) -> int:
 
 def _cmd_describe(args: argparse.Namespace) -> int:
     from tfk8s_tpu.api import serde
+    from tfk8s_tpu.client.apiserver import PLURALS
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
-    job = cs.tpujobs(args.namespace).get(args.name)
-    print(json.dumps(serde.to_wire(job), indent=2))
+    kind = PLURALS[getattr(args, "kind", "tpujobs")]
+    obj = cs.generic(kind, args.namespace).get(args.name)
+    print(json.dumps(serde.to_wire(obj), indent=2))
     # kubectl-describe parity: the object's event history, read from the
     # cluster's mirrored Event objects (operator EventRecorder sink)
     key = f"{args.namespace}/{args.name}"
@@ -685,11 +718,13 @@ def _cmd_apply(args: argparse.Namespace) -> int:
 
 
 def _cmd_delete(args: argparse.Namespace) -> int:
+    from tfk8s_tpu.client.apiserver import PLURALS
     from tfk8s_tpu.client.remote import clientset_from_kubeconfig
 
     cs = clientset_from_kubeconfig(args.kubeconfig)
-    cs.tpujobs(args.namespace).delete(args.name)
-    print(f"tpujob {args.namespace}/{args.name} deleted")
+    plural = getattr(args, "kind", "tpujobs")
+    cs.generic(PLURALS[plural], args.namespace).delete(args.name)
+    print(f"{plural[:-1]} {args.namespace}/{args.name} deleted")
     return 0
 
 

@@ -24,6 +24,7 @@ from tfk8s_tpu.cmd.options import Options
 from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.runtime.kubelet import LocalKubelet
 from tfk8s_tpu.trainer.gang import SliceAllocator
+from tfk8s_tpu.trainer.serve_controller import TPUServeController
 from tfk8s_tpu.trainer.tpujob_controller import TPUJobController
 from tfk8s_tpu.utils.logging import EventRecorder, Metrics, get_logger, init_logging
 
@@ -77,6 +78,19 @@ class Server:
         self.controller = TPUJobController(
             self.clientset,
             allocator=self.allocator,
+            recorder=self.recorder,
+            metrics=self.metrics,
+            resync_period=opts.resync_period_s,
+            tracer=self.tracer,
+        )
+        # the serving control plane (TPUServe -> batched model-server
+        # replicas) shares the clientset/recorder/registry — the serving
+        # data plane's request metrics land on the same /metrics
+        from tfk8s_tpu.runtime.server import set_metrics as _serve_set_metrics
+
+        _serve_set_metrics(self.metrics)
+        self.serve_controller = TPUServeController(
+            self.clientset,
             recorder=self.recorder,
             metrics=self.metrics,
             resync_period=opts.resync_period_s,
@@ -169,7 +183,8 @@ class Server:
             self.kubelet.run(stop)  # informer-driven; returns immediately
 
         if not self.opts.leader_elect:
-            log.info("starting controller with %d workers", self.opts.workers)
+            log.info("starting controllers with %d workers", self.opts.workers)
+            self.serve_controller.run(self.opts.workers, stop, block=False)
             self.controller.run(self.opts.workers, stop, block=block)
             if block:
                 stop.wait()
@@ -185,9 +200,10 @@ class Server:
 
         def lead(child_stop: threading.Event) -> None:
             log.info(
-                "acquired lease %s as %s; starting controller",
+                "acquired lease %s as %s; starting controllers",
                 self.opts.lease_name, self.opts.identity,
             )
+            self.serve_controller.run(self.opts.workers, child_stop, block=False)
             self.controller.run(self.opts.workers, child_stop, block=False)
 
         def run_elector():
@@ -204,3 +220,4 @@ class Server:
         if self._http is not None:
             self._http.shutdown()
         self.controller.controller.shutdown()
+        self.serve_controller.controller.shutdown()

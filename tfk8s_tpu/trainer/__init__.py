@@ -5,4 +5,5 @@ coordination contract, and the TPUJob controller that reconciles them.
 
 from tfk8s_tpu.trainer.gang import GangAssignment, SliceAllocator, SliceHandle  # noqa: F401
 from tfk8s_tpu.trainer.tpujob_controller import FINALIZER, TPUJobController  # noqa: F401
+from tfk8s_tpu.trainer.serve_controller import SERVE_FINALIZER, TPUServeController  # noqa: F401
 from tfk8s_tpu.trainer import labels, replicas  # noqa: F401

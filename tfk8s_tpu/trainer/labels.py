@@ -15,6 +15,11 @@ SLICE_ID = "tfk8s.dev/slice-id"
 HOST_INDEX = "tfk8s.dev/host-index"
 CONTROLLER = "tfk8s.dev/controller"
 CONTROLLER_NAME = "tpujob-operator"
+# Serving (TPUServe) pods: owner + the pod-template hash they were
+# rendered from (the rolling-update version identity, Deployment's
+# pod-template-hash analogue).
+SERVE_NAME = "tfk8s.dev/serve-name"
+SERVE_VERSION = "tfk8s.dev/serve-version"
 
 
 def job_selector(job_name: str) -> Dict[str, str]:
@@ -33,3 +38,12 @@ def replica_labels(job_name: str, rtype: ReplicaType, index: int) -> Dict[str, s
 
 def replica_type_selector(job_name: str, rtype: ReplicaType) -> Dict[str, str]:
     return {**job_selector(job_name), REPLICA_TYPE: rtype.value}
+
+
+def serve_selector(serve_name: str) -> Dict[str, str]:
+    """Selector matching every serving replica pod of a TPUServe."""
+    return {SERVE_NAME: serve_name, CONTROLLER: CONTROLLER_NAME}
+
+
+def serve_version_labels(serve_name: str, version: str) -> Dict[str, str]:
+    return {**serve_selector(serve_name), SERVE_VERSION: version}
