@@ -668,6 +668,228 @@ def _serving_probe(small: bool, full: bool = False):
     }
 
 
+def _recovery_probe(small: bool, full: bool = False):
+    """Elastic recovery time (ISSUE 6): kill 1 of 4 workers mid-epoch
+    with a reclaim notice against the REAL job controller + hermetic
+    kubelet, and time reclaim-delivery -> first post-resize optimizer
+    step observed on the control plane. Repeated rounds (the gang scales
+    back up between kills) give p50/p99 — the number that shows a
+    reclaim costs seconds of resize, not minutes of whole-gang
+    restart-from-checkpoint. The drain checkpoint is what the resized
+    world resumes from, so lost work is bounded by one step, not by the
+    periodic save interval. Hermetic and chip-free, like the
+    control-plane block."""
+    import shutil
+    import tempfile
+    import threading
+
+    import tfk8s_tpu.runtime.kubelet as kubelet_mod
+    from tfk8s_tpu.api import helpers
+    from tfk8s_tpu.api.types import (
+        ContainerSpec, ElasticPolicy, JobConditionType, ObjectMeta, PodPhase,
+        ReplicaSpec, ReplicaType, RunPolicy, SchedulingPolicy, TPUJob,
+        TPUJobSpec, TPUSpec,
+    )
+    from tfk8s_tpu.client import FakeClientset, NotFound
+    from tfk8s_tpu.runtime import LocalKubelet, registry
+    from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+    from tfk8s_tpu.trainer import labels as L
+    from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
+
+    rounds = 5 if (full or not small) else 2
+    workers, min_r, ckpt_every, log_every = 4, 2, 500, 5
+
+    def train(env, stop):
+        import dataclasses as _dc
+
+        from tfk8s_tpu.models import mlp
+        from tfk8s_tpu.runtime.launcher import ProcessContext
+        from tfk8s_tpu.runtime.train import run_task
+
+        env = dict(env)
+        if ProcessContext.from_env(env).process_id != 0:
+            env.pop("TFK8S_CHECKPOINT_DIR", None)  # one checkpoint writer
+        run_task(_dc.replace(mlp.make_task(), targets={}), env, stop)
+
+    registry.register("bench.recovery.train", train)
+
+    def wait(cond, timeout_s, period=0.02):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(period)
+        return False
+
+    old_flush = kubelet_mod.LOG_FLUSH_SECONDS
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-recovery-")
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-1": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    name = "bench-recovery"
+    try:
+        # inside the try: a setup failure must still restore the flush
+        # period and stop the agents, or it pollutes every later block
+        kubelet_mod.LOG_FLUSH_SECONDS = 0.05
+        kubelet.run(stop)
+        if not ctrl.run(workers=2, stop=stop, block=False):
+            raise RuntimeError("controller failed to start")
+        cs.tpujobs().create(TPUJob(
+            metadata=ObjectMeta(
+                name=name, annotations={CHECKPOINT_DIR_ANNOTATION: ckpt_dir}
+            ),
+            spec=TPUJobSpec(
+                replica_specs={ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ContainerSpec(
+                        entrypoint="bench.recovery.train",
+                        env={
+                            "TFK8S_TRAIN_STEPS": "10000000",
+                            "TFK8S_CHECKPOINT_EVERY": str(ckpt_every),
+                            "TFK8S_LOG_EVERY": str(log_every),
+                        },
+                    ),
+                )},
+                tpu=TPUSpec(accelerator="cpu-1"),
+                run_policy=RunPolicy(
+                    backoff_limit=3,
+                    scheduling=SchedulingPolicy(gang=True),
+                    elastic=ElasticPolicy(
+                        min_replicas=min_r, max_replicas=workers,
+                        # long enough that the resized world's first
+                        # progress report provably lands BEFORE the
+                        # debounced scale-up re-forms the gang again
+                        # (pads round wall-clock, never the sample)
+                        resize_debounce_s=3.0,
+                    ),
+                ),
+            ),
+        ))
+
+        def live_workers():
+            pods, _rv = cs.pods().list(label_selector=L.job_selector(name))
+            return [
+                p for p in pods
+                if p.metadata.deletion_timestamp is None
+                and p.metadata.labels.get(L.REPLICA_TYPE) == "Worker"
+            ]
+
+        def world_step(min_wv):
+            """Freshest reported optimizer step among RUNNING pods whose
+            world version is at least ``min_wv`` (0 when none reported
+            yet)."""
+            return max(
+                (
+                    p.status.training.get("step", 0)
+                    for p in live_workers()
+                    if p.status.phase == PodPhase.RUNNING
+                    and int(
+                        p.spec.containers[0].env.get("TFK8S_WORLD_VERSION", "0")
+                    ) >= min_wv
+                ),
+                default=0,
+            )
+
+        def status():
+            return cs.tpujobs().get(name).status
+
+        def at_full_size():
+            st = status()
+            return (
+                st.elastic_replicas is None
+                and helpers.has_condition(st, JobConditionType.RUNNING)
+                and len(live_workers()) == workers
+                and world_step(st.world_version) > 0
+            )
+
+        if not wait(at_full_size, 180):
+            raise RuntimeError("elastic job never reached steady state")
+
+        samples = []
+        for _ in range(rounds):
+            wv = status().world_version
+            pre_step = world_step(wv)
+            victim = sorted(
+                (
+                    p for p in live_workers()
+                    if p.status.phase == PodPhase.RUNNING
+                    and not p.metadata.name.endswith("-0")
+                ),
+                key=lambda p: p.metadata.name,
+            )[-1]
+            t0 = time.perf_counter()
+            kubelet.deliver_reclaim(victim.metadata.key, grace_s=5.0)
+            # recovered = a RE-FORMED world (no whole-gang restart: the
+            # backoff budget is asserted untouched below) has run
+            # optimizer steps past the pre-kill frontier
+            if not wait(lambda: world_step(wv + 1) > pre_step, 120):
+                raise RuntimeError(
+                    f"no world past v{wv} resumed beyond step {pre_step}"
+                )
+            samples.append(time.perf_counter() - t0)
+            # capacity "returns": wait out the debounced scale-up so the
+            # next round kills 1 of 4 again
+            if not wait(
+                lambda: status().world_version > wv + 1 and at_full_size(), 120
+            ):
+                raise RuntimeError(
+                    f"scale-up past world v{wv + 1} never landed"
+                )
+
+        st = status()
+        burned = st.gang_restarts
+        snap = ctrl.metrics.snapshot()["histograms"]
+        drain = next(
+            (
+                v for k, v in snap.items()
+                if k.startswith("tfk8s_drain_checkpoint_seconds")
+            ),
+            None,
+        )
+    finally:
+        try:
+            cs.tpujobs().delete(name)
+        except NotFound:
+            pass
+        # let pod threads leave JAX before teardown (exit mid-dispatch
+        # aborts the interpreter), then stop the agents
+        wait(lambda: not kubelet._claimed, 60, period=0.1)
+        stop.set()
+        ctrl.controller.shutdown()
+        kubelet_mod.LOG_FLUSH_SECONDS = old_flush
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    ordered = sorted(samples)
+    return {
+        "recovery_workers": workers,
+        "recovery_min_replicas": min_r,
+        "recovery_rounds": rounds,
+        "recovery_samples_s": [round(s, 3) for s in samples],
+        "recovery_p50_s": round(ordered[len(ordered) // 2], 3),
+        "recovery_p99_s": round(
+            ordered[min(int(len(ordered) * 0.99), len(ordered) - 1)], 3
+        ),
+        # resizes must never burn the restart budget — a nonzero value
+        # here means the legacy whole-gang path fired
+        "recovery_backoff_burned": burned,
+        # the periodic save interval (in steps) the drain checkpoint
+        # beats: resume loses at most the in-flight step, not up to
+        # ckpt_every steps of replay
+        "recovery_checkpoint_every_steps": ckpt_every,
+        **(
+            {
+                "recovery_drain_checkpoint_mean_s": round(
+                    drain["sum"] / drain["count"], 3
+                ),
+                "recovery_drain_checkpoints": drain["count"],
+            }
+            if drain and drain["count"]
+            else {}
+        ),
+    }
+
+
 _PROBE_CODE = """
 import os
 if os.environ.get("BENCH_PLATFORM"):
@@ -949,6 +1171,18 @@ def main() -> None:
             print(f"bench: serving probe failed: {exc}", file=sys.stderr)
             degraded.append("serving")
 
+    # -- elastic recovery: reclaim-notice -> resized-gang-training time
+    # against the real controller + kubelet (hermetic, chip-free) --------
+    recovery_block = None
+    if os.environ.get("BENCH_RECOVERY", "1") == "1":
+        try:
+            recovery_block = _recovery_probe(
+                small, full=os.environ.get("BENCH_RECOVERY_FULL") == "1"
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: recovery probe failed: {exc}", file=sys.stderr)
+            degraded.append("recovery")
+
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     baseline_note = {}
@@ -1145,6 +1379,7 @@ def main() -> None:
                     **({"recordio": recordio_block} if recordio_block else {}),
                     **({"images": image_block} if image_block else {}),
                     **({"serving": serving_block} if serving_block else {}),
+                    **({"recovery": recovery_block} if recovery_block else {}),
                     **(
                         {
                             "flash_attn_ms_seq2048": round(flash_ms, 3),
@@ -1206,7 +1441,11 @@ def main() -> None:
         print(f"bench: could not write {detail_name}: {exc}", file=sys.stderr)
         detail_name = None
 
-    print(build_headline(detail, image_block, detail_name, serving_block))
+    print(
+        build_headline(
+            detail, image_block, detail_name, serving_block, recovery_block
+        )
+    )
 
 
 # The driver-artifact contract (VERDICT r5 next #1), enforced by the
@@ -1216,7 +1455,10 @@ def main() -> None:
 HEADLINE_MAX_CHARS = 1800
 
 
-def build_headline(detail: dict, image_block, detail_name, serving_block=None) -> str:
+def build_headline(
+    detail: dict, image_block, detail_name, serving_block=None,
+    recovery_block=None,
+) -> str:
     """Assemble the final-stdout headline line from the full detail
     record: the fixed key set, the image-decode and serving rows when
     present, and a graceful degrade order that drops optional keys until
@@ -1280,6 +1522,21 @@ def build_headline(detail: dict, image_block, detail_name, serving_block=None) -
                 if k in serving_block
             }
         )
+    if recovery_block:
+        # the elastic-recovery rows ride the headline: seconds from a
+        # reclaim notice to the RESIZED gang's first post-resize optimizer
+        # step — the driver's acceptance keys for the recovery arm
+        headline_extra.update(
+            {
+                k: recovery_block[k]
+                for k in (
+                    "recovery_p50_s",
+                    "recovery_p99_s",
+                    "recovery_backoff_burned",
+                )
+                if k in recovery_block
+            }
+        )
     headline = {
         "metric": detail["metric"],
         "value": detail["value"],
@@ -1294,10 +1551,12 @@ def build_headline(detail: dict, image_block, detail_name, serving_block=None) -
         "bert_batch_size", "image_px", "image_decode_workers",
         "image_native_vs_pil", "img_per_sec_pil", "image_backend",
         "serving_model", "serving_p50_ms", "serving_batch_occupancy",
+        "recovery_backoff_burned",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
         "image_meets_budget", "img_per_sec_native",
         "serving_p99_ms", "serving_qps",
+        "recovery_p99_s", "recovery_p50_s",
         "image_decode_images_per_sec", "bert_base_mlm_step_time_ms",
     ):
         if len(line) <= HEADLINE_MAX_CHARS:

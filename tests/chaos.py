@@ -1,0 +1,104 @@
+"""Seeded chaos harness for the elastic/preemption e2e suite (ISSUE 6).
+
+Drives the two fault hooks the hermetic node agent exposes
+(`runtime/kubelet.py`):
+
+- ``LocalKubelet.deliver_reclaim`` / the ``tfk8s.dev/reclaim-at`` pod
+  annotation — the deadline-stamped reclaim NOTICE (SIGTERM-equivalent
+  soft drain ahead of the kill);
+- ``LocalKubelet.chaos_fail`` — the host dying out from under the
+  process (SIGKILL equivalent): the pod exits FAILED no matter what the
+  entrypoint was doing, even mid-drain.
+
+Composing them yields the three reclaim shapes real fleets see:
+
+- ``reclaim(pod)``            notice honored -> pod exits Drained;
+- ``reclaim_late(pod)``       notice arrives but the host dies before
+                              the drain completes -> pod exits Failed,
+                              the partial drain checkpoint (if any) is
+                              uncommitted and restore skips it;
+- ``kill(pod)``               the notice was DROPPED -> pod exits Failed
+                              with no warning at all (legacy whole-gang
+                              restart path).
+
+Every random choice goes through one seeded ``random.Random`` so a
+failing sweep replays bit-for-bit from its seed.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+from tfk8s_tpu.api.types import Pod, PodPhase
+from tfk8s_tpu.trainer import labels as L
+
+
+class ChaosInjector:
+    def __init__(self, clientset, kubelet, seed: int = 0):
+        self.cs = clientset
+        self.kubelet = kubelet
+        self.rng = random.Random(seed)
+        self.log: List[tuple] = []  # (wall time, action, pod key)
+
+    # -- target selection ---------------------------------------------------
+
+    def running_workers(self, job_name: str, namespace: str = "default") -> List[Pod]:
+        pods, _rv = self.cs.pods(namespace).list(
+            label_selector=L.job_selector(job_name)
+        )
+        return sorted(
+            (
+                p for p in pods
+                if p.status.phase == PodPhase.RUNNING
+                and p.metadata.deletion_timestamp is None
+                and p.metadata.labels.get(L.REPLICA_TYPE) == "Worker"
+            ),
+            key=lambda p: p.metadata.name,
+        )
+
+    def pick_worker(
+        self, job_name: str, namespace: str = "default",
+        exclude_index_0: bool = False,
+    ) -> Optional[Pod]:
+        """Seeded choice among the job's RUNNING workers. The elastic e2e
+        excludes worker 0 when only process 0 owns the checkpointer, so
+        the drain checkpoint provably comes from the survivor wave."""
+        pods = self.running_workers(job_name, namespace)
+        if exclude_index_0:
+            pods = [p for p in pods if not p.metadata.name.endswith("-0")]
+        return self.rng.choice(pods) if pods else None
+
+    # -- fault primitives ---------------------------------------------------
+
+    def reclaim(self, pod: Pod, grace_s: float = 5.0) -> float:
+        """Deliver a reclaim notice and let the pod drain in peace."""
+        self.log.append((time.time(), "reclaim", pod.metadata.key))
+        return self.kubelet.deliver_reclaim(pod.metadata.key, grace_s)
+
+    def kill(self, pod: Pod, message: str = "chaos: node died (notice dropped)") -> None:
+        """Kill the pod's host with NO notice — the dropped-notice case."""
+        self.log.append((time.time(), "kill", pod.metadata.key))
+        self.kubelet.chaos_fail(pod.metadata.key, message)
+
+    def reclaim_late(self, pod: Pod, notice_to_kill_s: float = 0.0,
+                     grace_s: float = 5.0) -> None:
+        """A LATE notice: delivered, but the host dies ``notice_to_kill_s``
+        later — usually before the drain checkpoint commits. With 0 the
+        kill is immediate (the notice raced the pull)."""
+        self.log.append((time.time(), "reclaim_late", pod.metadata.key))
+        self.kubelet.deliver_reclaim(pod.metadata.key, grace_s)
+        if notice_to_kill_s > 0:
+            t = threading.Timer(
+                notice_to_kill_s,
+                self.kubelet.chaos_fail,
+                args=(pod.metadata.key, "chaos: node died mid-drain (late notice)"),
+            )
+            t.daemon = True
+            t.start()
+        else:
+            self.kubelet.chaos_fail(
+                pod.metadata.key, "chaos: node died mid-drain (late notice)"
+            )

@@ -71,10 +71,24 @@ FULL_SERVING_BLOCK = {
 }
 
 
+FULL_RECOVERY_BLOCK = {
+    "recovery_workers": 4,
+    "recovery_min_replicas": 2,
+    "recovery_rounds": 5,
+    "recovery_samples_s": [1.92, 2.11, 1.87, 2.45, 2.03],
+    "recovery_p50_s": 2.03,
+    "recovery_p99_s": 2.45,
+    "recovery_backoff_burned": 0,
+    "recovery_checkpoint_every_steps": 500,
+    "recovery_drain_checkpoint_mean_s": 0.113,
+    "recovery_drain_checkpoints": 15,
+}
+
+
 def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
-        FULL_SERVING_BLOCK,
+        FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -85,11 +99,15 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert "control_plane" not in parsed["extra"]
     assert "noise" not in parsed["extra"]
     assert "serving_sweep" not in parsed["extra"]
+    assert "recovery_samples_s" not in parsed["extra"]
     # the driver's acceptance keys survive at normal sizes
     assert parsed["extra"]["img_per_sec_native"] == 1030.1
     assert parsed["extra"]["serving_qps"] == 2310.4
     assert parsed["extra"]["serving_p99_ms"] == 71.0
     assert parsed["extra"]["serving_batch_occupancy"] == 14.2
+    assert parsed["extra"]["recovery_p50_s"] == 2.03
+    assert parsed["extra"]["recovery_p99_s"] == 2.45
+    assert parsed["extra"]["recovery_backoff_burned"] == 0
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -98,7 +116,8 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     fat = dict(FULL_EXTRA)
     fat["degraded_sections"] = [f"section_{i:03d}" for i in range(60)]
     line = bench.build_headline(
-        _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK
+        _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
+        FULL_RECOVERY_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -113,15 +132,19 @@ def test_headline_without_image_block():
     parsed = json.loads(line)
     assert "image_backend" not in parsed["extra"]
     assert "serving_qps" not in parsed["extra"]
+    assert "recovery_p50_s" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
 def test_serving_keys_in_drop_order():
-    """Every serving headline key must appear in the degrade order — a
-    key outside it could hold the line over the ceiling forever."""
+    """Every serving/recovery headline key must appear in the degrade
+    order — a key outside it could hold the line over the ceiling
+    forever."""
     import inspect
 
     src = inspect.getsource(bench.build_headline)
     for key in ("serving_qps", "serving_p50_ms", "serving_p99_ms",
-                "serving_batch_occupancy", "serving_model"):
+                "serving_batch_occupancy", "serving_model",
+                "recovery_p50_s", "recovery_p99_s",
+                "recovery_backoff_burned"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

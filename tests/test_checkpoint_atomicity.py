@@ -1,0 +1,181 @@
+"""Checkpoint atomicity (ISSUE 6 satellite + the drain-checkpoint
+contract): a save is only discoverable once its commit marker exists,
+and the marker is written strictly AFTER the save is durable
+(``runtime/checkpoint.py`` ``.tfk8s_commits``). A kill mid-write —
+exactly where a late reclaim notice lands — leaves a partial step dir
+that latest-step discovery SKIPS, so restore falls back to the previous
+committed step instead of crashing (or worse, half-loading)."""
+
+import os
+import shutil
+
+import jax.numpy as jnp
+import pytest
+
+from tfk8s_tpu.runtime.checkpoint import _COMMITS_DIRNAME, Checkpointer
+
+
+def _state(v: float):
+    return {"w": jnp.full((4,), v), "b": jnp.full((2,), v * 10)}
+
+
+@pytest.fixture
+def ckpt(tmp_path):
+    c = Checkpointer(str(tmp_path / "ck"))
+    if not c.enabled:
+        pytest.skip("orbax unavailable")
+    yield c
+    c.close()
+
+
+def _commit_dir(ckpt):
+    return os.path.join(ckpt.directory, _COMMITS_DIRNAME)
+
+
+def test_save_wait_commits_marker_and_discovers(ckpt):
+    ckpt.save(10, _state(1.0), wait=True)
+    assert os.path.exists(os.path.join(_commit_dir(ckpt), "10"))
+    assert ckpt.latest_step() == 10
+    restored = ckpt.restore(_state(0.0))
+    assert float(restored["w"][0]) == 1.0
+
+
+def test_async_save_commits_at_next_barrier(ckpt):
+    # save(10) async: its marker lands when the NEXT save barriers on it
+    ckpt.save(10, _state(1.0))
+    ckpt.save(20, _state(2.0))
+    assert os.path.exists(os.path.join(_commit_dir(ckpt), "10"))
+    ckpt.wait_until_finished()
+    assert ckpt.all_steps() == [10, 20]
+    assert ckpt.latest_step() == 20
+
+
+def test_uncommitted_partial_step_dir_is_skipped_on_restore(ckpt):
+    """The kill-mid-write case: step 20's data dir exists (possibly
+    truncated) but its marker never landed — discovery must resume from
+    10, and restore must succeed there."""
+    ckpt.save(10, _state(1.0), wait=True)
+    ckpt.save(20, _state(2.0), wait=True)
+    # simulate the kill landing between the data write and the commit:
+    # the marker is gone, the step dir (maybe truncated) remains
+    os.remove(os.path.join(_commit_dir(ckpt), "20"))
+    step_dir = os.path.join(ckpt.directory, "20")
+    assert os.path.isdir(step_dir)
+    # truncate the step dir for good measure — it must not even be read
+    for name in os.listdir(step_dir)[1:]:
+        p = os.path.join(step_dir, name)
+        shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+
+    fresh = Checkpointer(ckpt.directory)  # the restarted process
+    try:
+        assert fresh.latest_step() == 10
+        assert fresh.all_steps() == [10]
+        restored = fresh.restore(_state(0.0))
+        assert float(restored["w"][0]) == 1.0
+    finally:
+        fresh.close()
+
+
+def test_save_async_window_is_invisible_until_committed(ckpt, tmp_path):
+    """A second process (the relaunched gang, the evaluator) polling the
+    directory never sees a step whose save is still in its async
+    window."""
+    ckpt.save(10, _state(1.0), wait=True)
+    ckpt.save_async(20, _state(2.0))
+    reader = Checkpointer(ckpt.directory)
+    try:
+        # the reader may or may not see orbax's files for 20 yet; either
+        # way the UNCOMMITTED step is not a restore candidate
+        assert reader.latest_step() in (10,)
+        ckpt.wait_until_finished()  # commit
+        assert reader.latest_step() == 20
+    finally:
+        reader.close()
+
+
+def test_first_save_into_fresh_dir_activates_gate_before_writing(ckpt):
+    """A kill during the FIRST-ever save must not be trusted via the
+    no-registry legacy fallback: save_async creates the marker registry
+    before the step dir starts materializing, so the partial first save
+    is skipped like any other uncommitted step."""
+    ckpt.save_async(10, _state(1.0))
+    # the registry exists the moment the first save starts...
+    assert os.path.isdir(_commit_dir(ckpt))
+    # ...so a restarted process (the writer died before committing) sees
+    # NO restorable step — never a possibly-truncated step 10
+    fresh = Checkpointer(ckpt.directory)
+    try:
+        assert fresh.latest_step() is None
+        assert fresh.all_steps() == []
+        ckpt.wait_until_finished()
+        assert fresh.latest_step() == 10
+    finally:
+        fresh.close()
+
+
+def test_maybe_commit_bounds_replay_to_one_interval(ckpt):
+    """A periodic save(wait=False) must become restorable once its async
+    write drains — NOT only at the next save's barrier — or a cold kill
+    in the following window replays up to two intervals."""
+    import time
+
+    ckpt.save(10, _state(1.0))
+    deadline = time.time() + 30
+    while ckpt.saving_in_progress() and time.time() < deadline:
+        time.sleep(0.01)
+    ckpt.maybe_commit()
+    assert os.path.exists(os.path.join(_commit_dir(ckpt), "10"))
+    fresh = Checkpointer(ckpt.directory)  # the cold-killed-then-restarted process
+    try:
+        assert fresh.latest_step() == 10
+    finally:
+        fresh.close()
+
+
+def test_retention_prunes_marker_registry(tmp_path):
+    """The registry must not grow one marker per step forever: commits
+    prune markers whose step dir orbax's max_to_keep retention deleted."""
+    c = Checkpointer(str(tmp_path / "prune"), max_to_keep=2)
+    if not c.enabled:
+        pytest.skip("orbax unavailable")
+    try:
+        for step in (10, 20, 30, 40):
+            c.save(step, _state(float(step)), wait=True)
+        markers = sorted(
+            int(n) for n in os.listdir(_commit_dir(c)) if n.isdigit()
+        )
+        assert markers == c.all_steps(), markers
+        assert len(markers) <= 2
+    finally:
+        c.close()
+
+
+def test_legacy_directory_without_marker_registry_still_restores(ckpt):
+    """Back-compat: a checkpoint tree written before the marker scheme
+    (no .tfk8s_commits dir at all) is trusted as orbax discovers it."""
+    ckpt.save(10, _state(1.0), wait=True)
+    ckpt.save(20, _state(2.0), wait=True)
+    shutil.rmtree(_commit_dir(ckpt))
+    fresh = Checkpointer(ckpt.directory)
+    try:
+        assert fresh.latest_step() == 20
+        restored = fresh.restore(_state(0.0))
+        assert float(restored["w"][0]) == 2.0
+    finally:
+        fresh.close()
+
+
+def test_gc_leaves_stale_markers_harmless(tmp_path):
+    """orbax's max_to_keep GC removes old step dirs; their stale markers
+    must not resurrect deleted steps in discovery."""
+    c = Checkpointer(str(tmp_path / "gc"), max_to_keep=2)
+    if not c.enabled:
+        pytest.skip("orbax unavailable")
+    try:
+        for step in (10, 20, 30):
+            c.save(step, _state(float(step)), wait=True)
+        steps = c.all_steps()
+        assert 30 in steps and len(steps) <= 2
+        assert c.latest_step() == 30
+    finally:
+        c.close()

@@ -1,0 +1,644 @@
+"""Elastic, preemption-tolerant training e2e (ISSUE 6 acceptance): a
+4-worker elastic TPUJob trains under the real controller + kubelet while
+the seeded chaos harness (tests/chaos.py) reclaims capacity.
+
+- reclaim notice honored -> the victim drains, the controller RESIZES the
+  gang to the survivors (no whole-gang restart, ``backoff_limit``
+  untouched), the re-formed world resumes from the drain checkpoint —
+  no step-0 reset, step counter monotone across the resize;
+- notice DROPPED (host dies cold) -> the legacy whole-gang
+  restart-from-checkpoint path still converges (burning one unit of
+  backoff, as it always did);
+- capacity returns -> the gang scales back up to the spec count, but only
+  after ``resize_debounce_s``;
+- a TPUServe replica on reclaimed capacity drains under the rollout
+  contract with ZERO failed requests;
+- controller-side per-job scratch maps are pruned on job deletion
+  (ISSUE 6 satellite: the `_pending_restart_counts` leak).
+
+The long seeded chaos sweep is marked ``slow`` (tier-1 budget).
+"""
+
+import dataclasses
+import re
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+import tfk8s_tpu.runtime.kubelet as kubelet_mod
+import tfk8s_tpu.trainer.serve_controller as sc_mod
+import tfk8s_tpu.trainer.tpujob_controller as jc_mod
+from tfk8s_tpu.api import helpers
+from tfk8s_tpu.api.types import (
+    ContainerSpec,
+    ElasticPolicy,
+    JobConditionType,
+    ObjectMeta,
+    PodPhase,
+    ReplicaSpec,
+    ReplicaType,
+    RunPolicy,
+    SchedulingPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from tfk8s_tpu.client import FakeClientset, NotFound
+from tfk8s_tpu.runtime import LocalKubelet, registry
+from tfk8s_tpu.runtime.checkpoint import Checkpointer
+from tfk8s_tpu.runtime.launcher import ProcessContext
+from tfk8s_tpu.runtime.registry import PodDrained
+from tfk8s_tpu.runtime.train import run_task
+from tfk8s_tpu.trainer import SliceAllocator, TPUJobController
+from tfk8s_tpu.trainer import labels as L
+from tfk8s_tpu.trainer.replicas import CHECKPOINT_DIR_ANNOTATION
+
+from chaos import ChaosInjector
+from conftest import wait_for
+
+OBS = {}
+
+
+@registry.register("elastic-e2e.train")
+def _elastic_train(env, stop):
+    """Every worker runs the REAL production path (run_task: env contract
+    -> mesh -> resume -> fit -> drain). Process 0 owns the shared
+    checkpoint directory; the rest train checkpoint-free (one writer per
+    gang — the hermetic stand-in for orbax's multi-host coordination).
+    Each incarnation records what it saw, keyed by job name."""
+    from tfk8s_tpu.models import mlp
+
+    env = dict(env)
+    ctx = ProcessContext.from_env(env)
+    ckpt_step = None
+    if ctx.checkpoint_dir and ctx.process_id == 0:
+        probe = Checkpointer(ctx.checkpoint_dir)
+        ckpt_step = probe.latest_step() if probe.enabled else None
+        probe.close()
+    rec = {
+        "pid": ctx.process_id,
+        "world": ctx.world_version,
+        "gang_restarts": ctx.gang_restarts,
+        "resuming": ctx.resuming,
+        "ckpt_step_at_start": ckpt_step,
+        "num_processes": ctx.num_processes,
+    }
+    OBS.setdefault(ctx.job_name, []).append(rec)
+    if ctx.process_id != 0:
+        env.pop("TFK8S_CHECKPOINT_DIR", None)  # process 0 owns the writer
+    task = dataclasses.replace(mlp.make_task(), targets={})
+    try:
+        rec["final"] = run_task(task, env, stop)
+    except PodDrained as e:
+        m = re.search(r"step (\d+)", str(e))
+        rec["drain_step"] = int(m.group(1)) if m else None
+        raise
+
+
+def make_elastic_job(
+    name, ckpt_dir, workers=4, min_r=2, max_r=None, debounce=300.0,
+    steps=50_000, ckpt_every=1000, log_every=10, backoff=3,
+):
+    return TPUJob(
+        metadata=ObjectMeta(
+            name=name, annotations={CHECKPOINT_DIR_ANNOTATION: ckpt_dir}
+        ),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=ContainerSpec(
+                        entrypoint="elastic-e2e.train",
+                        env={
+                            "TFK8S_TRAIN_STEPS": str(steps),
+                            "TFK8S_CHECKPOINT_EVERY": str(ckpt_every),
+                            "TFK8S_LOG_EVERY": str(log_every),
+                        },
+                    ),
+                )
+            },
+            tpu=TPUSpec(accelerator="cpu-1"),
+            run_policy=RunPolicy(
+                backoff_limit=backoff,
+                scheduling=SchedulingPolicy(gang=True),
+                elastic=ElasticPolicy(
+                    min_replicas=min_r,
+                    max_replicas=max_r or workers,
+                    resize_debounce_s=debounce,
+                ),
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def cluster(monkeypatch):
+    monkeypatch.setattr(kubelet_mod, "LOG_FLUSH_SECONDS", 0.05)
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-1": 2}))
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    yield cs, ctrl, kubelet, stop
+    # trainers are still mid-run when a test ends: delete the jobs and let
+    # every pod thread leave its JAX dispatch before the interpreter goes
+    # away (an exiting process under an active XLA computation aborts)
+    try:
+        jobs, _ = cs.tpujobs().list()
+        for j in jobs:
+            try:
+                cs.tpujobs().delete(j.metadata.name)
+            except NotFound:
+                pass
+        wait_for(lambda: not kubelet._claimed, timeout=60)
+    except Exception:  # noqa: BLE001 — teardown is best-effort
+        pass
+    stop.set()
+    ctrl.controller.shutdown()
+
+
+def job_status(cs, name):
+    try:
+        return cs.tpujobs().get(name).status
+    except NotFound:
+        return None
+
+
+def running(cs, name):
+    def check():
+        st = job_status(cs, name)
+        return st is not None and helpers.has_condition(
+            st, JobConditionType.RUNNING
+        )
+
+    return check
+
+
+def live_workers(cs, name):
+    pods, _ = cs.pods().list(label_selector=L.job_selector(name))
+    return [
+        p for p in pods
+        if p.metadata.deletion_timestamp is None
+        and p.metadata.labels.get(L.REPLICA_TYPE) == "Worker"
+    ]
+
+
+def reported_step(cs, pod_name):
+    try:
+        return cs.pods().get(pod_name).status.training.get("step", 0)
+    except NotFound:
+        return 0
+
+
+def test_reclaim_notice_resizes_gang_without_burning_backoff(cluster, tmp_path):
+    """The acceptance core: kill 1 of 4 workers mid-epoch (WITH notice)
+    -> the job resizes to 3, backoff_limit unchanged, the re-formed
+    world resumes from the drain checkpoint (no step-0 reset), and the
+    observed step counter is monotone across the resize."""
+    cs, ctrl, kubelet, _stop = cluster
+    name = "elastic"
+    OBS.pop(name, None)
+    cs.tpujobs().create(
+        make_elastic_job(name, str(tmp_path / "ckpt"), debounce=300.0)
+    )
+    assert wait_for(running(cs, name), timeout=90)
+    assert wait_for(
+        lambda: reported_step(cs, f"{name}-worker-0") >= 20, timeout=90
+    ), "worker 0 never reported training progress"
+
+    chaos = ChaosInjector(cs, kubelet, seed=7)
+    victim = chaos.pick_worker(name, exclude_index_0=True)
+    assert victim is not None
+    chaos.reclaim(victim, grace_s=5.0)
+
+    def resized():
+        st = job_status(cs, name)
+        return (
+            st is not None
+            and st.world_version == 1
+            and st.elastic_replicas == 3
+        )
+
+    assert wait_for(resized, timeout=60)
+
+    def reformed():
+        if not running(cs, name)():
+            return False
+        pods = live_workers(cs, name)
+        return len(pods) == 3 and all(
+            p.spec.containers[0].env.get("TFK8S_WORLD_VERSION") == "1"
+            and p.status.phase == PodPhase.RUNNING
+            for p in pods
+        )
+
+    assert wait_for(reformed, timeout=90)
+
+    st = job_status(cs, name)
+    assert st.gang_restarts == 0, "resize must not burn backoff_limit"
+    assert st.preemptions == 0
+    assert not helpers.has_condition(st, JobConditionType.FAILED)
+
+    # resume contract: the world-1 incarnation of process 0 restored the
+    # DRAIN checkpoint, at the exact step the world-0 incarnation drained
+    def resumed():
+        recs = OBS.get(name, [])
+        drains = [r for r in recs if r["pid"] == 0 and r.get("drain_step")]
+        world1 = [r for r in recs if r["pid"] == 0 and r["world"] == 1]
+        return bool(drains and world1)
+
+    assert wait_for(resumed, timeout=60)
+    drain = [r for r in OBS[name] if r["pid"] == 0 and r.get("drain_step")][0]
+    world1 = [r for r in OBS[name] if r["pid"] == 0 and r["world"] == 1][0]
+    assert drain["drain_step"] > 0
+    assert world1["resuming"] is True
+    assert world1["ckpt_step_at_start"] == drain["drain_step"], (
+        "resized gang must resume from the drain checkpoint, not an older "
+        "periodic save (and never from step 0)"
+    )
+    assert world1["num_processes"] == 3
+
+    # monotone step counter, observed from the control plane
+    assert wait_for(
+        lambda: reported_step(cs, f"{name}-worker-0") >= drain["drain_step"],
+        timeout=90,
+    )
+
+    # operator surface: resize event + direction-labeled counter +
+    # per-job recovery gauge + the drain-checkpoint histogram
+    assert any(e.reason == "ElasticResize" for e in ctrl.recorder.events())
+    assert any(e.reason == "ResizeComplete" for e in ctrl.recorder.events())
+    assert ctrl.metrics.get_counter(
+        "tfk8s_elastic_resizes_total", {"direction": "down"}
+    ) == 1.0
+    recovery = ctrl.metrics.get_gauge(
+        "tpujob.recovery_seconds", {"namespace": "default", "job": name}
+    )
+    assert recovery is not None and recovery > 0
+    hists = ctrl.metrics.snapshot()["histograms"]
+    assert any(k.startswith("tfk8s_drain_checkpoint_seconds") for k in hists)
+
+
+def test_dropped_notice_converges_via_legacy_restart(cluster, tmp_path):
+    """A host dying with NO notice is still the legacy failure model:
+    whole-gang restart-from-checkpoint, one unit of backoff burned —
+    elastic policy or not, an unannounced death is not a drain."""
+    cs, ctrl, kubelet, _stop = cluster
+    name = "elastic-drop"
+    OBS.pop(name, None)
+    cs.tpujobs().create(
+        make_elastic_job(
+            name, str(tmp_path / "ckpt"), debounce=300.0, ckpt_every=30
+        )
+    )
+    assert wait_for(running(cs, name), timeout=90)
+    # past step 70 the step-30 periodic save is durably COMMITTED (its
+    # marker is written when the step-60 save starts)
+    assert wait_for(
+        lambda: reported_step(cs, f"{name}-worker-0") >= 70, timeout=90
+    )
+
+    chaos = ChaosInjector(cs, kubelet, seed=11)
+    victim = chaos.pick_worker(name, exclude_index_0=True)
+    chaos.kill(victim)
+
+    def restarted():
+        st = job_status(cs, name)
+        return st is not None and st.gang_restarts == 1
+
+    assert wait_for(restarted, timeout=60)
+
+    def recovered():
+        if not running(cs, name)():
+            return False
+        pods = live_workers(cs, name)
+        return len(pods) == 4 and all(
+            p.spec.containers[0].env.get("TFK8S_GANG_RESTARTS") == "1"
+            for p in pods
+        )
+
+    assert wait_for(recovered, timeout=90)
+    st = job_status(cs, name)
+    assert st.world_version == 0  # no resize happened
+    assert st.elastic_replicas is None
+
+    def resumed():
+        recs = OBS.get(name, [])
+        return any(
+            r["pid"] == 0 and r["gang_restarts"] == 1
+            and r["resuming"] and (r["ckpt_step_at_start"] or 0) > 0
+            for r in recs
+        )
+
+    assert wait_for(resumed, timeout=60), (
+        f"restarted gang never resumed from checkpoint: {OBS.get(name)}"
+    )
+
+
+def test_capacity_return_scales_back_up_debounced(cluster, tmp_path):
+    """After a resize down, the controller restores the spec-desired
+    count — but only once ``resize_debounce_s`` has elapsed, and the
+    scale-up drains the running world first so the step counter stays
+    monotone through BOTH resizes."""
+    cs, ctrl, kubelet, _stop = cluster
+    name = "elastic-up"
+    OBS.pop(name, None)
+    cs.tpujobs().create(
+        make_elastic_job(name, str(tmp_path / "ckpt"), debounce=2.0)
+    )
+    assert wait_for(running(cs, name), timeout=90)
+    assert wait_for(
+        lambda: reported_step(cs, f"{name}-worker-0") >= 20, timeout=90
+    )
+
+    chaos = ChaosInjector(cs, kubelet, seed=3)
+    t_down = time.time()
+    chaos.reclaim(chaos.pick_worker(name, exclude_index_0=True), grace_s=5.0)
+    assert wait_for(
+        lambda: (job_status(cs, name) or TPUJob().status).world_version == 1,
+        timeout=60,
+    )
+
+    # capacity "returns" (cpu slices are virtual): world 2 restores the
+    # desired 4 workers after the debounce
+    def scaled_up():
+        st = job_status(cs, name)
+        return (
+            st is not None
+            and st.world_version == 2
+            and st.elastic_replicas is None
+        )
+
+    assert wait_for(scaled_up, timeout=90)
+    assert time.time() - t_down >= 2.0, "scale-up ignored the debounce"
+    assert wait_for(
+        lambda: running(cs, name)() and len(live_workers(cs, name)) == 4,
+        timeout=90,
+    )
+    st = job_status(cs, name)
+    assert st.gang_restarts == 0
+    assert ctrl.metrics.get_counter(
+        "tfk8s_elastic_resizes_total", {"direction": "down"}
+    ) == 1.0
+    assert ctrl.metrics.get_counter(
+        "tfk8s_elastic_resizes_total", {"direction": "up"}
+    ) == 1.0
+
+    # monotone resume across both resizes: world 2's process 0 restored
+    # at (at least) the step world 1 drained at, which itself resumed
+    # from world 0's drain step
+    def chain_done():
+        recs = [r for r in OBS.get(name, []) if r["pid"] == 0]
+        return any(r["world"] == 2 for r in recs)
+
+    assert wait_for(chain_done, timeout=60)
+    recs = [r for r in OBS[name] if r["pid"] == 0]
+    w1 = next(r for r in recs if r["world"] == 1)
+    w2 = next(r for r in recs if r["world"] == 2)
+    assert w2["resuming"] is True
+    assert w2["num_processes"] == 4
+    assert (
+        w2["ckpt_step_at_start"]
+        >= w1["ckpt_step_at_start"]
+        > 0
+    )
+
+
+def test_reclaimed_serve_replica_drains_with_zero_failed_requests(monkeypatch):
+    """TPUServe on reclaimable capacity: a replica under a reclaim notice
+    unregisters FIRST, finishes its accepted requests, exits Drained,
+    and the controller replaces it — hammered concurrently, not one
+    request fails (the rollout availability contract extended to
+    involuntary drains)."""
+    monkeypatch.setattr(kubelet_mod, "LOG_FLUSH_SECONDS", 0.05)
+    monkeypatch.setattr(sc_mod, "AUTOSCALE_PERIOD_S", 0.1)
+    from tfk8s_tpu.api.types import BatchingPolicy, TPUServeSpec, TPUServe
+    from tfk8s_tpu.runtime.server import ServeClient
+    from tfk8s_tpu.trainer import TPUServeController
+
+    cs = FakeClientset()
+    ctrl = TPUServeController(cs)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    try:
+        serve = TPUServe(
+            metadata=ObjectMeta(name="spot-serve"),
+            spec=TPUServeSpec(
+                task="echo", checkpoint="v1", replicas=3,
+                batching=BatchingPolicy(
+                    max_batch_size=8, batch_timeout_ms=2.0, queue_limit=256
+                ),
+            ),
+        )
+        serve.spec.template.env["TFK8S_SERVE_ECHO_DELAY_MS"] = "3"
+        cs.tpuserves().create(serve)
+        assert wait_for(
+            lambda: cs.tpuserves().get("spot-serve").status.ready_replicas == 3,
+            timeout=60,
+        )
+
+        failures, served = [], []
+        hammer_stop = threading.Event()
+        client = ServeClient(cs, "spot-serve")
+
+        def hammer(i):
+            while not hammer_stop.is_set():
+                try:
+                    client.request(float(i), timeout=30)
+                    served.append(1)
+                except Exception as e:  # noqa: BLE001 — every failure counts
+                    failures.append(e)
+
+        with ThreadPoolExecutor(4) as pool:
+            for i in range(4):
+                pool.submit(hammer, i)
+            time.sleep(0.5)
+            pods, _ = cs.pods().list(
+                label_selector=L.serve_selector("spot-serve")
+            )
+            victim = sorted(pods, key=lambda p: p.metadata.name)[1]
+            kubelet.deliver_reclaim(victim.metadata.key, grace_s=5.0)
+
+            # the drained replica is replaced and the set heals to 3
+            def healed():
+                try:
+                    cur = cs.pods().get(victim.metadata.name)
+                    if cur.metadata.uid == victim.metadata.uid:
+                        return False  # old carcass still there
+                except NotFound:
+                    pass
+                return (
+                    cs.tpuserves().get("spot-serve").status.ready_replicas == 3
+                )
+
+            assert wait_for(healed, timeout=60)
+            time.sleep(0.5)  # keep hammering the healed set a moment
+            hammer_stop.set()
+
+        assert not failures, f"requests failed during reclaim: {failures[:3]}"
+        assert len(served) > 50
+        assert any(
+            e.reason == "ReplicaReclaimed" for e in ctrl.recorder.events()
+        ), "the graceful drain should be visible as ReplicaReclaimed"
+    finally:
+        stop.set()
+        ctrl.controller.shutdown()
+
+
+def test_deleted_job_prunes_all_controller_scratch_maps():
+    """ISSUE 6 satellite: every per-job scratch map empties on delete —
+    including the pod-keyed ``_pending_restart_counts`` (the leak), and
+    WITHOUT collateral damage to a job whose name shares a prefix."""
+    cs = FakeClientset()
+    ctrl = TPUJobController(cs, allocator=SliceAllocator())
+    key = "default/leaky"
+    ctrl._gang_restarts_floor[key] = 2
+    ctrl._preemptions_floor[key] = 1
+    ctrl._elastic_floor[key] = (3, 2)
+    ctrl._resize_started[key] = (time.time(), "down")
+    ctrl._last_resize[key] = time.time()
+    ctrl._evaluator_failures_seen.add((key, "uid-1"))
+    ctrl._pending_restart_counts["default/leaky-worker-0"] = 2
+    ctrl._pending_restart_counts["default/leaky-evaluator-1"] = 1
+    # decoys that must SURVIVE: another namespace, and the job named
+    # "leaky-worker" whose pods continue past the digits
+    ctrl._pending_restart_counts["other/leaky-worker-0"] = 7
+    ctrl._pending_restart_counts["default/leaky-worker-worker-0"] = 7
+    ctrl._gang_restarts_floor["default/other"] = 9
+
+    ctrl._prune_job_state(key)
+
+    assert key not in ctrl._gang_restarts_floor
+    assert key not in ctrl._preemptions_floor
+    assert key not in ctrl._elastic_floor
+    assert key not in ctrl._resize_started
+    assert key not in ctrl._last_resize
+    assert not any(e[0] == key for e in ctrl._evaluator_failures_seen)
+    assert ctrl._pending_restart_counts == {
+        "other/leaky-worker-0": 7,
+        "default/leaky-worker-worker-0": 7,
+    }
+    assert ctrl._gang_restarts_floor == {"default/other": 9}
+
+
+def test_cold_crash_during_resize_window_defers_to_failure_accounting(tmp_path):
+    """A worker that cold-crashes (FAILED, no reclaim notice) in the same
+    sync as a resize trigger must NOT be consumed by the resize: the
+    world-version bump would reclassify the carcass as a stale-world pod
+    and the shepherd would delete it with no backoff/restart accounting.
+    _handle_elastic defers so the legacy failure machinery runs first; a
+    FAILED pod WITH the notice (the late-notice case) is resize
+    collateral and does not defer."""
+    from tfk8s_tpu.api.types import Pod, PodSpec, PodStatus
+    from tfk8s_tpu.runtime.kubelet import RECLAIM_AT_ANNOTATION
+
+    ctrl = TPUJobController(FakeClientset(), allocator=SliceAllocator())
+    job = make_elastic_job("cold", str(tmp_path / "ck"), debounce=0.0)
+    job.status.elastic_replicas = 3
+    job.status.world_version = 1
+    job._elastic_desired = 4
+
+    def pod(i, phase, annotations=None):
+        return Pod(
+            metadata=ObjectMeta(
+                name=f"cold-worker-{i}", namespace="default",
+                labels={L.REPLICA_TYPE: "Worker"},
+                annotations=dict(annotations or {}),
+            ),
+            spec=PodSpec(containers=[
+                ContainerSpec(entrypoint="x", env={"TFK8S_WORLD_VERSION": "1"})
+            ]),
+            status=PodStatus(phase=phase),
+        )
+
+    observed = {f"cold-worker-{i}": pod(i, PodPhase.RUNNING) for i in range(3)}
+    observed["cold-worker-3"] = pod(3, PodPhase.FAILED)
+
+    # scale-up is due (debounce 0, eff 3 < desired 4) but the cold crash
+    # defers the whole elastic sync — no world bump, failure path's turn
+    assert ctrl._handle_elastic(job, observed) is False
+    assert job.status.world_version == 1
+
+    # the SAME crash carrying the reclaim notice is drain collateral: the
+    # resize proceeds (down, victims excluded) and bumps the world
+    observed["cold-worker-3"] = pod(
+        3, PodPhase.FAILED, {RECLAIM_AT_ANNOTATION: "1.000"}
+    )
+    assert ctrl._handle_elastic(job, observed) is True
+    assert job.status.world_version == 2
+
+
+@pytest.mark.slow
+def test_seeded_chaos_sweep_always_recovers(cluster, tmp_path):
+    """The long sweep: a seeded mix of clean reclaims, dropped notices,
+    and late notices against one elastic job. After every fault the job
+    must return to Running with a monotone resume step, and the backoff
+    budget must only ever be spent on UNANNOUNCED deaths."""
+    cs, ctrl, kubelet, _stop = cluster
+    name = "chaos-sweep"
+    OBS.pop(name, None)
+    cs.tpujobs().create(
+        make_elastic_job(
+            name, str(tmp_path / "ckpt"), debounce=1.0, ckpt_every=30,
+            backoff=6,
+        )
+    )
+    assert wait_for(running(cs, name), timeout=90)
+    assert wait_for(
+        lambda: reported_step(cs, f"{name}-worker-0") >= 70, timeout=120
+    )
+
+    chaos = ChaosInjector(cs, kubelet, seed=42)
+    kills = 0
+    for round_no in range(3):
+        action = chaos.rng.choice(["reclaim", "kill", "reclaim_late"])
+        victim = chaos.pick_worker(name, exclude_index_0=True)
+        assert victim is not None, f"round {round_no}: no victim available"
+        pre_step = max(
+            reported_step(cs, p.metadata.name) for p in live_workers(cs, name)
+        )
+        if action == "reclaim":
+            chaos.reclaim(victim, grace_s=5.0)
+        elif action == "kill":
+            chaos.kill(victim)
+            kills += 1
+        else:
+            chaos.reclaim_late(victim, notice_to_kill_s=0.05)
+            kills += 1
+
+        def stable():
+            st = job_status(cs, name)
+            if st is None or helpers.is_failed(st):
+                return False
+            if not helpers.has_condition(st, JobConditionType.RUNNING):
+                return False
+            pods = live_workers(cs, name)
+            return pods and all(
+                p.status.phase == PodPhase.RUNNING for p in pods
+            )
+
+        assert wait_for(stable, timeout=120), (
+            f"round {round_no} ({action}) never stabilized: "
+            f"{job_status(cs, name)} chaos={chaos.log}"
+        )
+        # monotone recovery: training picks up at/above where it was
+        assert wait_for(
+            lambda: max(
+                (reported_step(cs, p.metadata.name)
+                 for p in live_workers(cs, name)),
+                default=0,
+            ) >= pre_step,
+            timeout=120,
+        ), f"round {round_no} ({action}): step counter regressed"
+
+    st = job_status(cs, name)
+    assert not helpers.is_failed(st)
+    # only UNANNOUNCED deaths may burn backoff
+    assert st.gang_restarts <= kills, (
+        f"clean reclaims burned backoff: restarts={st.gang_restarts}, "
+        f"unannounced kills={kills}, chaos={chaos.log}"
+    )

@@ -43,6 +43,10 @@ def test_default_scope_covers_hotpath_counters():
         "tfk8s_serving_smoothed_queue_depth": False,
         "tfk8s_serving_scale_events_total": False,
         "tfk8s_serving_rollouts_total": False,
+        # ISSUE-6 elastic series: the recovery bench arm and the chaos
+        # e2e assert against these exact names
+        "tfk8s_elastic_resizes_total": False,
+        "tfk8s_drain_checkpoint_seconds": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

@@ -23,6 +23,8 @@ from tfk8s_tpu.utils import topology as topo
 DEFAULT_ACCELERATOR = "cpu-1"
 DEFAULT_MAX_RESTARTS = 3
 DEFAULT_BACKOFF_LIMIT = 3
+# Seconds a downsized elastic gang holds steady before scaling back up.
+DEFAULT_RESIZE_DEBOUNCE_S = 5.0
 
 # The in-process model server (runtime/server.py): what a TPUServe pod
 # runs unless the template pins another entrypoint.
@@ -53,6 +55,25 @@ def set_defaults(job: TPUJob) -> TPUJob:
         rp.clean_pod_policy = CleanPodPolicy.RUNNING
     if rp.backoff_limit is None:
         rp.backoff_limit = DEFAULT_BACKOFF_LIMIT
+
+    el = rp.elastic
+    if el is not None:
+        if el.resize_debounce_s is None:
+            el.resize_debounce_s = DEFAULT_RESIZE_DEBOUNCE_S
+        worker = spec.replica_specs.get(ReplicaType.WORKER)
+        if el.max_replicas is None:
+            el.max_replicas = (worker.replicas if worker else None) or 1
+        if el.min_replicas is None:
+            # the smallest world a resize may shrink to: one host on the
+            # hermetic backend, one whole slice on real TPU (a slice
+            # admits and fails as a unit — validation enforces alignment)
+            try:
+                info = topo.parse_accelerator(
+                    spec.tpu.accelerator, spec.tpu.topology
+                )
+                el.min_replicas = 1 if info.generation == "cpu" else info.hosts
+            except topo.TopologyError:
+                el.min_replicas = 1
 
     # Default mesh: one pure data-parallel axis over every chip in the job.
     if spec.mesh is None:

@@ -136,6 +136,12 @@ class PodPhase(str, enum.Enum):
     RUNNING = "Running"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # Terminal like FAILED, but GRACEFUL: the entrypoint honored a reclaim
+    # notice (runtime/kubelet.py RECLAIM_AT_ANNOTATION) — finished its
+    # in-flight step, committed a drain checkpoint, and exited. The job
+    # controller answers a Drained worker with an elastic resize (or a
+    # preemption-style restart) instead of burning backoff_limit.
+    DRAINED = "Drained"
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +221,26 @@ class SchedulingPolicy:
 
 
 @dataclass
+class ElasticPolicy:
+    """Elastic world sizing for the Worker replica set (TorchElastic-style
+    min/max bounds translated to TPU gang semantics). When set, a Drained
+    worker (reclaim notice honored, runtime/kubelet.py) shrinks the gang
+    to the surviving count instead of triggering a whole-gang
+    restart-from-checkpoint — as long as the survivors stay >=
+    ``min_replicas`` — and the controller grows the gang back toward the
+    spec count (debounced by ``resize_debounce_s``) when capacity
+    returns. Resizes never consume ``backoff_limit``. On real TPU slices
+    the resize granularity is a WHOLE slice (a slice admits and fails as
+    a unit), so validation rejects bounds that are not slice-aligned."""
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    # Seconds a downsized gang must hold steady before scaling back up —
+    # capacity that flaps must not thrash the mesh.
+    resize_debounce_s: Optional[float] = None
+
+
+@dataclass
 class RunPolicy:
     clean_pod_policy: Optional[CleanPodPolicy] = None
     ttl_seconds_after_finished: Optional[float] = None
@@ -226,6 +252,8 @@ class RunPolicy:
     # to False re-admits and resumes from checkpoint.
     suspend: bool = False
     scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+    # Elastic world sizing (None = fixed-size gang, the legacy semantics).
+    elastic: Optional[ElasticPolicy] = None
 
 
 @dataclass
@@ -273,6 +301,13 @@ class TPUJobStatus:
     preemptions: int = 0
     # Checkpoint step the gang last persisted (resume point on restart).
     checkpoint_step: Optional[int] = None
+    # Elastic state (RunPolicy.elastic): the CURRENT effective Worker
+    # count (None = the spec-desired count), and a monotonically bumped
+    # world version rendered into every pod as TFK8S_WORLD_VERSION — a
+    # resize re-forms the mesh at the new size and the nonzero version
+    # makes the relaunched processes resume from the drain checkpoint.
+    elastic_replicas: Optional[int] = None
+    world_version: int = 0
 
 
 # ---------------------------------------------------------------------------

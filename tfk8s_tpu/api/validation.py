@@ -107,6 +107,55 @@ def validate(job: TPUJob) -> List[str]:
                     f"({spec.tpu.accelerator} x {spec.tpu.num_slices})"
                 )
 
+    el = job.spec.run_policy.elastic
+    if el is not None:
+        path = "spec.runPolicy.elastic"
+        worker = spec.replica_specs.get(ReplicaType.WORKER)
+        n_workers = (worker.replicas if worker else None) or 0
+        if not job.spec.run_policy.scheduling.gang:
+            errs.append(f"{path}: requires gang scheduling (resize re-forms the gang)")
+        if worker is None:
+            errs.append(f"{path}: requires a Worker replica set to resize")
+        mn, mx = el.min_replicas or 0, el.max_replicas or 0
+        if mn < 1:
+            errs.append(f"{path}.minReplicas: must be >= 1, got {mn}")
+        if mx < mn:
+            errs.append(
+                f"{path}.maxReplicas: must be >= minReplicas ({mn}), got {mx}"
+            )
+        if worker is not None and not mn <= n_workers <= mx:
+            errs.append(
+                f"{path}: Worker replicas {n_workers} outside "
+                f"[minReplicas={mn}, maxReplicas={mx}]"
+            )
+        if el.resize_debounce_s is not None and el.resize_debounce_s < 0:
+            errs.append(
+                f"{path}.resizeDebounceS: must be >= 0, got {el.resize_debounce_s}"
+            )
+        if info is not None and info.generation != "cpu":
+            # TPU resize granularity is a WHOLE slice: a slice admits and
+            # fails as a unit, so the gang cannot shrink below (or sit
+            # between) slice boundaries. One process per host means the
+            # boundary is hosts-per-slice.
+            if ReplicaType.CHIEF in spec.replica_specs:
+                errs.append(
+                    f"{path}: elastic TPU gangs must be Worker-only "
+                    "(a Chief pins process 0 outside the resizable set)"
+                )
+            for fname, v in (("minReplicas", mn), ("maxReplicas", mx)):
+                if v and info.hosts and v % info.hosts:
+                    errs.append(
+                        f"{path}.{fname}: {v} is not a multiple of "
+                        f"hosts-per-slice ({info.hosts}) — a gang cannot "
+                        "shrink below a slice boundary"
+                    )
+            if spec.mesh is not None and set(spec.mesh.axes) != {"data"}:
+                errs.append(
+                    f"{path}: only a pure data-parallel mesh can be "
+                    f"re-derived on resize (got axes "
+                    f"{sorted(spec.mesh.axes)})"
+                )
+
     rp = job.spec.run_policy
     if rp.backoff_limit is not None and rp.backoff_limit < 0:
         errs.append("spec.runPolicy.backoffLimit: must be >= 0")

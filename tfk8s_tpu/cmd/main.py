@@ -868,7 +868,9 @@ def _follow_logs(cs, args: argparse.Namespace, printed) -> int:
                 for line in tail[start:]:
                     print(line, flush=True)
                 last = list(tail)
-            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            if pod.status.phase in (
+                PodPhase.SUCCEEDED, PodPhase.FAILED, PodPhase.DRAINED
+            ):
                 return 0
     except KeyboardInterrupt:
         pass

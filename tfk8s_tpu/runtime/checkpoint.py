@@ -45,6 +45,16 @@ except Exception:  # noqa: BLE001
 # plain paths, which keep the historical abspath normalization.
 _URI_RE = re.compile(r"^[a-z][a-z0-9+.\-]*://")
 
+# Commit-marker registry (atomic-commit discovery): a step only counts as
+# restorable once its marker file exists under ``<dir>/.tfk8s_commits/``,
+# and the marker is written strictly AFTER the save durably finished — so
+# a kill mid-write (preemption landing inside the drain checkpoint) can
+# never corrupt latest-step discovery: the partial step dir simply has no
+# marker and restore falls back to the previous committed step. Local
+# directories only (the fake-GCS root included); true remote URIs keep
+# orbax/tensorstore's own atomicity and discovery.
+_COMMITS_DIRNAME = ".tfk8s_commits"
+
 
 def resolve_directory(directory: str) -> str:
     """Normalize a checkpoint location. Plain paths → absolute; URIs pass
@@ -66,6 +76,15 @@ class Checkpointer:
         self.directory = resolve_directory(directory) if directory else directory
         self.max_to_keep = max_to_keep
         self._mgr = None
+        # steps whose orbax save was STARTED but whose commit marker is
+        # not yet written (the async window); committed once the save is
+        # known durable (wait_until_finished / the next save's barrier)
+        self._pending: list = []
+        self._commit_dir = (
+            os.path.join(self.directory, _COMMITS_DIRNAME)
+            if self.directory and not _URI_RE.match(self.directory)
+            else None
+        )
         if _HAVE_ORBAX and directory:
             if not _URI_RE.match(self.directory):
                 os.makedirs(self.directory, exist_ok=True)
@@ -82,13 +101,80 @@ class Checkpointer:
     def enabled(self) -> bool:
         return self._mgr is not None
 
+    # -- commit markers -----------------------------------------------------
+
+    def _write_marker(self, step: int) -> None:
+        with open(os.path.join(self._commit_dir, str(int(step))), "w") as f:
+            f.write("committed\n")
+
+    def _commit_pending(self) -> None:
+        """Write markers for every pending step, then prune markers whose
+        step dir orbax retention has deleted (the registry must not grow
+        one file per step forever). ONLY call once the saves are known
+        durable (after ``wait_until_finished``)."""
+        if self._commit_dir is None:
+            self._pending.clear()
+            return
+        if self._pending:
+            os.makedirs(self._commit_dir, exist_ok=True)
+        for step in self._pending:
+            self._write_marker(step)
+        self._pending.clear()
+        try:
+            retained = set(self._mgr.all_steps())
+            for n in os.listdir(self._commit_dir):
+                if n.isdigit() and int(n) not in retained:
+                    os.remove(os.path.join(self._commit_dir, n))
+        except OSError:
+            pass  # pruning is housekeeping; stale markers are harmless
+
+    def _committed_only(self, steps: list) -> list:
+        """Filter a step listing down to COMMITTED steps. A directory with
+        no marker registry at all (written by raw orbax, or pre-marker
+        code) is trusted as-is — strict gating applies once this class
+        has ever committed here."""
+        if self._commit_dir is None or not os.path.isdir(self._commit_dir):
+            return list(steps)
+        try:
+            marked = {
+                int(n) for n in os.listdir(self._commit_dir) if n.isdigit()
+            }
+        except OSError:
+            return list(steps)
+        return [s for s in steps if s in marked]
+
     def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self.save_async(step, state)
+        if wait:
+            self.wait_until_finished()
+
+    def save_async(self, step: int, state: Any) -> None:
+        """Start an async save and return while it drains on orbax's
+        background thread — the drain path's checkpoint (training has
+        already stopped; the overlap buys the reclaim deadline). The
+        step's commit marker is written only once the save is known
+        durable, so a kill mid-save leaves a partial dir that
+        latest-step discovery skips."""
         if not self.enabled:
             return
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
-        if wait:
+        if self._pending:
+            # orbax serializes async saves anyway; making the barrier
+            # explicit lets the PREVIOUS step commit before this one opens
+            # its own vulnerability window
             self._mgr.wait_until_finished()
-        log.info("saved checkpoint step=%d -> %s", step, self.directory)
+            self._commit_pending()
+        if self._commit_dir is not None and not os.path.isdir(self._commit_dir):
+            # FIRST save into this directory: activate the strict gate
+            # before the step dir starts materializing, grandfathering any
+            # pre-marker (raw-orbax/legacy) steps — otherwise a kill mid-
+            # first-save leaves a partial dir that a fresh registry-less
+            # directory would TRUST instead of skip
+            os.makedirs(self._commit_dir, exist_ok=True)
+            for s in self._mgr.all_steps():
+                self._write_marker(s)
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._pending.append(int(step))
+        log.info("saving checkpoint step=%d -> %s", step, self.directory)
 
     def saving_in_progress(self) -> bool:
         """True while an async save is still draining on orbax's background
@@ -103,15 +189,25 @@ class Checkpointer:
     def wait_until_finished(self) -> None:
         if self.enabled:
             self._mgr.wait_until_finished()
+            self._commit_pending()
+
+    def maybe_commit(self) -> None:
+        """Commit pending markers iff the async save has finished draining
+        — never blocks. Cheap enough for the step loop: without it a
+        periodic ``save(wait=False)`` stays uncommitted until the NEXT
+        save's barrier, so a cold kill inside the following window would
+        discard a fully durable checkpoint and double the replay."""
+        if self.enabled and self._pending and not self.saving_in_progress():
+            self._commit_pending()
 
     def all_steps(self) -> list:
-        """Every retained checkpoint step, ascending (cadence assertions
-        and retention inspection)."""
+        """Every retained COMMITTED checkpoint step, ascending (cadence
+        assertions and retention inspection)."""
         if not self.enabled:
             return []
         if hasattr(self._mgr, "reload"):
             self._mgr.reload()
-        return sorted(self._mgr.all_steps())
+        return sorted(self._committed_only(self._mgr.all_steps()))
 
     def latest_step(self) -> Optional[int]:
         if not self.enabled:
@@ -127,7 +223,11 @@ class Checkpointer:
                 "orbax CheckpointManager has no reload(); cross-process "
                 "pollers will only see checkpoints that existed at open time"
             )
-        return self._mgr.latest_step()
+        # commit-marker gate: a partial step dir left by a kill mid-save
+        # (or a save still in its async window) must never be the resume
+        # point — discovery returns the newest COMMITTED step
+        steps = self._committed_only(self._mgr.all_steps())
+        return max(steps) if steps else None
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the shape/sharding of ``state_like`` (an abstract or
@@ -150,4 +250,5 @@ class Checkpointer:
     def close(self) -> None:
         if self._mgr is not None:
             self._mgr.wait_until_finished()
+            self._commit_pending()
             self._mgr.close()

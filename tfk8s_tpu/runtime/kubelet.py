@@ -26,6 +26,7 @@ import traceback
 from typing import Deque, Dict, List, Optional, Tuple
 
 from tfk8s_tpu.api.types import Pod, PodPhase
+from tfk8s_tpu.runtime.registry import PodDrained
 from tfk8s_tpu.client.clientset import Clientset
 from tfk8s_tpu.client.informer import ResourceEventHandler, SharedIndexInformer
 from tfk8s_tpu.client.store import Conflict, NotFound, Unavailable
@@ -56,11 +57,70 @@ NODE_LEASE_PREFIX = "node-"
 # settings applied after first import were silently ignored).
 NODE_LEASE_DURATION_DEFAULT_S = 20.0
 NODE_LEASE_RENEW_DEFAULT_S = 4.0
+# Reclaim notice (spot/preemptible capacity): the deadline-stamped pod
+# annotation that warns a pod its host is about to be pulled — the
+# hermetic analogue of the 30-second TPU reclaim notice. Writers (chaos
+# harness, the job controller's resize drain, reclaim_node) PATCH the
+# annotation through the apiserver; the kubelet's pod watch turns it into
+# a soft drain signal on the entrypoint's PodStopSignal, ahead of any
+# hard kill. Value: absolute epoch-seconds deadline.
+RECLAIM_AT_ANNOTATION = "tfk8s.dev/reclaim-at"
+
+
+def reclaim_patch(deadline: float) -> dict:
+    """The merge-patch body that stamps a reclaim deadline on an object —
+    the ONE place the annotation's wire format is written (kubelet,
+    controller resize drain, chaos harness all patch through this)."""
+    return {"metadata": {"annotations": {
+        RECLAIM_AT_ANNOTATION: f"{deadline:.3f}"
+    }}}
+
+
+def parse_reclaim_at(obj) -> Optional[float]:
+    """Deadline from an object's reclaim annotation, or None when absent
+    or malformed — the ONE place the wire format is read."""
+    raw = obj.metadata.annotations.get(RECLAIM_AT_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning(
+            "malformed reclaim deadline %r on %s", raw, obj.metadata.key
+        )
+        return None
+
 # How long a pod phase write keeps retrying through an apiserver outage.
 # Sized to cover a full control-plane restart (journal replay + interpreter
 # start, tens of seconds under load) with margin; teardown paths exit
 # early via the kubelet stop event.
 STATUS_WRITE_RETRY_S = 300.0
+
+
+class PodStopSignal(threading.Event):
+    """The per-pod stop handle the kubelet hands each entrypoint. The
+    Event itself is the HARD stop (deletion / node death — SIGKILL
+    equivalent); ``request_drain`` layers the SOFT reclaim phase on top
+    (SIGTERM equivalent): entrypoints that check ``drain_requested`` get
+    ``drain_deadline`` seconds to finish the in-flight step, commit a
+    checkpoint, and raise :class:`~tfk8s_tpu.runtime.registry.PodDrained`;
+    entrypoints that only watch the Event keep the legacy semantics."""
+
+    def __init__(self):
+        super().__init__()
+        self._drain = threading.Event()
+        self.drain_deadline: Optional[float] = None
+
+    def request_drain(self, deadline: float) -> None:
+        # first notice wins: a re-delivered (or later) notice must not
+        # push the deadline out from under a drain already in progress
+        if not self._drain.is_set():
+            self.drain_deadline = deadline
+        self._drain.set()
+
+    @property
+    def drain_requested(self) -> bool:
+        return self._drain.is_set()
 
 
 class _PodLogRouter(logging.Handler):
@@ -141,8 +201,13 @@ class LocalKubelet:
                 on_delete=self._on_delete,
             )
         )
-        self._claimed: Dict[str, threading.Event] = {}
+        self._claimed: Dict[Tuple[str, str], PodStopSignal] = {}
         self._lock = threading.Lock()
+        # chaos-harness hook (tests/chaos.py): (key, uid) -> failure
+        # message. A poisoned pod's thread raises when its entrypoint
+        # returns — the hermetic simulation of the host dying out from
+        # under the process (dropped/late reclaim notice).
+        self._chaos_fail: Dict[Tuple[str, str], str] = {}
         # Always a real Event (run() swaps in the caller's): every retry
         # wait in this file can be a stop-aware _stop.wait, so shutdown
         # never stalls behind a fixed sleep. _started gates the loops
@@ -271,7 +336,9 @@ class LocalKubelet:
                 return False
             if current.metadata.uid != uid:
                 return False
-            if current.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            if current.status.phase in (
+                PodPhase.SUCCEEDED, PodPhase.FAILED, PodPhase.DRAINED
+            ):
                 return False  # terminal writer already published
             if (
                 current.status.log_tail == lines
@@ -301,8 +368,11 @@ class LocalKubelet:
     def _on_update(self, old: Pod, new: Pod) -> None:
         if new.metadata.deletion_timestamp is not None:
             self._signal_stop(new.metadata.key)
-        else:
-            self._maybe_run(new)
+            return
+        reclaim_at = parse_reclaim_at(new)
+        if reclaim_at is not None:
+            self._signal_drain(new.metadata.key, reclaim_at)
+        self._maybe_run(new)
 
     def _on_delete(self, obj) -> None:
         # Deletion is how the controller stops a pod (gang restart,
@@ -317,6 +387,66 @@ class LocalKubelet:
         for ev in evs:
             ev.set()
 
+    def _signal_drain(self, key: str, deadline: float) -> None:
+        with self._lock:
+            evs = [ev for (k, _uid), ev in self._claimed.items() if k == key]
+        for ev in evs:
+            ev.request_drain(deadline)
+
+    # -- reclaim / chaos hooks ---------------------------------------------
+
+    def deliver_reclaim(self, pod_key: str, grace_s: float) -> float:
+        """Deliver a reclaim notice to one pod: stamp the deadline
+        annotation through the apiserver (so every watcher — controller
+        included — sees the notice) AND signal the local drain event
+        directly, so the grace clock starts now rather than a watch
+        round-trip later. Returns the deadline."""
+        deadline = time.time() + grace_s
+        ns, name = pod_key.split("/", 1)
+        try:
+            self.cs.pods(ns).patch(name, reclaim_patch(deadline))
+        except (NotFound, Conflict, Unavailable, OSError) as e:
+            log.warning("%s: reclaim annotation for %s failed: %s",
+                        self.name, pod_key, e)
+        self._signal_drain(pod_key, deadline)
+        return deadline
+
+    def reclaim_node(self, grace_s: float) -> List[str]:
+        """Node-level reclaim notice (the v5p 30-second pull): mark THIS
+        node's Lease with the reclaim deadline — the ReclaimNotice node
+        condition any controller can observe — and drain every pod the
+        node is running. Returns the notified pod keys."""
+        deadline = time.time() + grace_s
+        try:
+            leases = self.cs.generic("Lease", "default")
+            lease = leases.get(NODE_LEASE_PREFIX + self.name)
+            lease.metadata.annotations.update(
+                reclaim_patch(deadline)["metadata"]["annotations"]
+            )
+            leases.update(lease)
+        except Exception as e:  # noqa: BLE001 — notice delivery is best-effort
+            log.warning("%s: node reclaim condition failed: %s", self.name, e)
+        with self._lock:
+            keys = sorted({k for (k, _uid) in self._claimed})
+        for key in keys:
+            self.deliver_reclaim(key, grace_s)
+        return keys
+
+    def chaos_fail(self, pod_key: str, message: str = "chaos: node died") -> None:
+        """Chaos-harness hook: kill a pod's host WITHOUT (or after) a
+        notice — the entrypoint is hard-stopped and its exit is recorded
+        as FAILED with ``message``, even if it was mid-drain. This is how
+        tests/chaos.py simulates a dropped or late reclaim notice."""
+        with self._lock:
+            targets = [
+                (claim, ev) for claim, ev in self._claimed.items()
+                if claim[0] == pod_key
+            ]
+            for claim, _ev in targets:
+                self._chaos_fail[claim] = message
+        for _claim, ev in targets:
+            ev.set()
+
     def _maybe_run(self, pod: Pod) -> None:
         if pod.status.phase != PodPhase.PENDING:
             return
@@ -327,8 +457,11 @@ class LocalKubelet:
         with self._lock:
             if claim in self._claimed:
                 return
-            pod_stop = threading.Event()
+            pod_stop = PodStopSignal()
             self._claimed[claim] = pod_stop
+        reclaim_at = parse_reclaim_at(pod)
+        if reclaim_at is not None:
+            pod_stop.request_drain(reclaim_at)
         t = threading.Thread(
             target=self._run_pod, args=(pod, pod_stop), name=f"pod-{pod.metadata.name}",
             daemon=True,
@@ -451,12 +584,24 @@ class LocalKubelet:
                             f"injected failure {n + 1}/{fail_times}"
                         )
                 fn = registry.resolve(container.entrypoint)
-                registry.call(fn, env, pod_stop)
+                try:
+                    registry.call(fn, env, pod_stop)
+                    phase, message, code = PodPhase.SUCCEEDED, "", 0
+                except PodDrained as e:
+                    # the entrypoint honored the reclaim notice: in-flight
+                    # step finished, drain checkpoint committed — a
+                    # GRACEFUL terminal phase, not a failure
+                    phase, message, code = PodPhase.DRAINED, str(e), 0
+                # chaos poison outranks the entrypoint's own exit: the
+                # "host" died, so even a drained result never made it out
+                poison = self._chaos_fail.pop((key, uid), None)
+                if poison is not None:
+                    raise RuntimeError(poison)
                 # the terminal write carries the FINAL progress report too
                 # — the 1s flusher usually misses the report fired right
                 # before the entrypoint returns (the step==steps boundary)
                 self._set_phase(
-                    key, uid, PodPhase.SUCCEEDED, exit_code=0,
+                    key, uid, phase, message=message, exit_code=code,
                     log_tail=list(buf), training=_progress.snapshot(ident),
                 )
         except Exception as e:  # noqa: BLE001 — container or kubelet failure
@@ -476,6 +621,7 @@ class LocalKubelet:
             _progress.clear(ident)
             with self._lock:
                 self._claimed.pop((key, uid), None)
+                self._chaos_fail.pop((key, uid), None)
                 self._log_bufs.pop((key, uid), None)
                 self._log_published.pop((key, uid), None)
                 self._progress_idents.pop((key, uid), None)

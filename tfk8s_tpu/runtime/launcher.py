@@ -42,6 +42,10 @@ class ProcessContext:
     slice_id: str = ""
     host_index: int = 0
     gang_restarts: int = 0
+    # Elastic world version (trainer/replicas.py TFK8S_WORLD_VERSION):
+    # bumped by the controller on every gang resize; nonzero means this
+    # incarnation is a re-formed world and must resume from checkpoint.
+    world_version: int = 0
     checkpoint_dir: str = ""
     mesh: Optional[MeshConfig] = None
 
@@ -62,6 +66,7 @@ class ProcessContext:
             slice_id=e.get("TFK8S_SLICE_ID", ""),
             host_index=int(e.get("TFK8S_HOST_INDEX", "0")),
             gang_restarts=int(e.get("TFK8S_GANG_RESTARTS", "0")),
+            world_version=int(e.get("TFK8S_WORLD_VERSION", "0")),
             checkpoint_dir=e.get("TFK8S_CHECKPOINT_DIR", ""),
             mesh=mesh,
         )
@@ -72,9 +77,10 @@ class ProcessContext:
 
     @property
     def resuming(self) -> bool:
-        """True on a gang restart — the process must restore from the last
-        checkpoint (SURVEY.md §5 checkpoint/resume contract)."""
-        return self.gang_restarts > 0
+        """True on a gang restart OR after an elastic resize — either way
+        the process must restore from the last committed checkpoint
+        (SURVEY.md §5 checkpoint/resume contract)."""
+        return self.gang_restarts > 0 or self.world_version > 0
 
 
 def force_platform(platform: str, num_devices: Optional[int] = None) -> bool:

@@ -18,6 +18,14 @@ from typing import Callable, Dict, Optional
 _REGISTRY: Dict[str, Callable] = {}
 
 
+class PodDrained(Exception):
+    """Raised by an entrypoint that honored a reclaim notice: it finished
+    its in-flight work, committed its drain checkpoint, and is exiting
+    GRACEFULLY. The kubelet maps this to ``PodPhase.DRAINED`` (not
+    Failed), which is what lets the job controller resize the gang
+    instead of burning ``backoff_limit``."""
+
+
 def register(name: str, fn: Optional[Callable] = None):
     """``register("name", fn)`` or ``@register("name")`` decorator."""
     if fn is None:

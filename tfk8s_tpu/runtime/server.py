@@ -670,8 +670,18 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
     server.report_progress()
     log.info("%s: serving %s (%s) ready; version=%s", key, task, checkpoint,
              model.version)
+    reclaimed = False
     try:
         while not stop.wait(PROGRESS_PERIOD_S):
+            # a reclaim notice (runtime/kubelet.py PodStopSignal) is an
+            # immediate graceful exit for a serving replica: there is no
+            # step to finish — unregister now so the client routes away,
+            # drain the accepted queue, and exit Drained so the
+            # controller replaces rather than failure-counts the pod
+            if getattr(stop, "drain_requested", False):
+                reclaimed = True
+                log.info("%s: reclaim notice; draining replica", key)
+                break
             server.report_progress()
     finally:
         # drain order matters: unregister FIRST so the client stops
@@ -683,6 +693,10 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
         )
         log.info("%s: drained=%s after %d requests in %d batches",
                  key, drained, server.served_total, server.batches_total)
+    if reclaimed:
+        from tfk8s_tpu.runtime.registry import PodDrained
+
+        raise PodDrained(f"{key}: replica drained on reclaim notice")
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,7 @@ from tfk8s_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, MeshConfig
 from tfk8s_tpu.runtime import progress
 from tfk8s_tpu.runtime.checkpoint import Checkpointer
 from tfk8s_tpu.runtime.launcher import ProcessContext, build_mesh, initialize_distributed
+from tfk8s_tpu.runtime.registry import PodDrained
 from tfk8s_tpu.utils.logging import get_logger
 
 log = get_logger("train")
@@ -440,6 +441,10 @@ class Trainer:
         self.config = config
         self.mesh = mesh
         self.optimizer = config.make_optimizer()
+        # set by fit() when a reclaim notice drained the run: the step the
+        # drain checkpoint committed at (run_task turns this into a
+        # PodDrained exit instead of a missed-target failure)
+        self.drained_at: Optional[int] = None
         # set by fit() in per-host input mode: (shard_lo, shard_hi, total)
         self.input_shard_range: Optional[Tuple[int, int, int]] = None
         self._per_host_active = False
@@ -1047,6 +1052,16 @@ class Trainer:
                 if stop is not None and getattr(stop, "is_set", lambda: False)():
                     log.info("%s: stop requested at step %d", self.task.name, step)
                     break
+                if stop is not None and getattr(stop, "drain_requested", False):
+                    # reclaim notice (runtime/kubelet.py PodStopSignal):
+                    # the previous step is finished — fall out to the
+                    # drain checkpoint below and exit Drained
+                    self.drained_at = step
+                    log.info(
+                        "%s: reclaim notice at step %d; draining",
+                        self.task.name, step,
+                    )
+                    break
                 it_t0 = time.perf_counter()
                 if step == prof_start:
                     jax.profiler.start_trace(cfg.profile_dir)
@@ -1113,6 +1128,11 @@ class Trainer:
                     log.info("%s: profile trace written to %s", self.task.name, cfg.profile_dir)
                 if ckpt and cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
                     ckpt.save(step, state)
+                elif ckpt:
+                    # commit the previous periodic save's marker as soon as
+                    # its async write drains — a cold kill later in this
+                    # window must not discard a durable checkpoint
+                    ckpt.maybe_commit()
                 if step % cfg.log_every == 0 or step == cfg.steps:
                     # ONE batched transfer for the whole metrics dict
                     # (per-scalar fetches cost a tunnel round trip each)
@@ -1193,7 +1213,27 @@ class Trainer:
         if profiling:  # run ended inside the trace window
             jax.profiler.stop_trace()
         if ckpt and ckpt.enabled:
-            ckpt.save(int(state.step), state, wait=True)
+            if self.drained_at is not None:
+                # drain checkpoint: async start (overlaps the reclaim
+                # grace window), then barrier on the commit marker —
+                # durability is the whole point of the notice. A kill
+                # landing mid-save leaves an uncommitted partial dir that
+                # latest-step discovery skips (runtime/checkpoint.py).
+                t0 = time.perf_counter()
+                final_step = int(state.step)
+                ckpt.save_async(final_step, state)
+                ckpt.wait_until_finished()
+                drain_s = time.perf_counter() - t0
+                self.drained_at = final_step
+                progress.report(
+                    drain_checkpoint_seconds=drain_s, step=final_step
+                )
+                log.info(
+                    "%s: drain checkpoint step=%d committed in %.3fs",
+                    self.task.name, final_step, drain_s,
+                )
+            else:
+                ckpt.save(int(state.step), state, wait=True)
             ckpt.close()
         return state, history
 
@@ -1401,6 +1441,14 @@ def _run_task_inner(
 
     trainer = Trainer(task, config, mesh)
     state, history = trainer.fit(stop=stop)
+    if trainer.drained_at is not None:
+        # a drained run is INCOMPLETE by design — skip the convergence
+        # targets and exit the graceful terminal phase the controller's
+        # elastic resize keys off (kubelet maps this to PodPhase.DRAINED)
+        raise PodDrained(
+            f"{task.name}: drained at step {trainer.drained_at} on reclaim "
+            "notice"
+        )
     final = history[-1] if history else {}
     for metric, target in task.targets.items():
         got = final.get(metric)

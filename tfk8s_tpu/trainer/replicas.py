@@ -75,6 +75,11 @@ def coordination_env(
         "TFK8S_GANG_RESTARTS": str(
             job.status.gang_restarts + job.status.preemptions
         ),
+        # elastic world identity: bumped by the controller on every gang
+        # resize, so a relaunched process knows its world was re-formed
+        # (launcher resume contract) and stale-world pods are
+        # identifiable during the resize drain
+        "TFK8S_WORLD_VERSION": str(job.status.world_version),
     }
     if job.spec.mesh is not None:
         env["TFK8S_MESH"] = json.dumps(job.spec.mesh.axes)

@@ -288,21 +288,30 @@ class TPUServeController:
         # -- observe --------------------------------------------------------
         version = _serve_version(serve)
         observed = self.pods.list(ns, L.serve_selector(name))
+        terminal = (PodPhase.FAILED, PodPhase.SUCCEEDED, PodPhase.DRAINED)
         live = [
             p for p in observed
             if p.metadata.deletion_timestamp is None
-            and p.status.phase not in (PodPhase.FAILED, PodPhase.SUCCEEDED)
+            and p.status.phase not in terminal
         ]
-        # Failed/completed serving pods are replaced, not restarted in
-        # place: delete the carcass; the create pass below brings a fresh
-        # replica (new uid -> clean load()->Ready cycle).
+        # Failed/completed/drained serving pods are replaced, not
+        # restarted in place: delete the carcass; the create pass below
+        # brings a fresh replica (new uid -> clean load()->Ready cycle).
+        # DRAINED is the graceful case — the replica honored a reclaim
+        # notice, unregistered first, and finished its accepted requests
+        # under the rollout availability contract (zero failed requests).
         for p in observed:
             if (
-                p.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED)
+                p.status.phase in terminal
                 and p.metadata.deletion_timestamp is None
             ):
+                reason = (
+                    "ReplicaReclaimed"
+                    if p.status.phase == PodPhase.DRAINED
+                    else "ReplicaFailed"
+                )
                 self.recorder.event(
-                    "TPUServe", key, "ReplicaFailed",
+                    "TPUServe", key, reason,
                     f"{p.metadata.name}: {p.status.phase.value} "
                     f"{p.status.message}".strip(),
                 )

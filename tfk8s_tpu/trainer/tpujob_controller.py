@@ -29,6 +29,7 @@ hard part 2):
 
 from __future__ import annotations
 
+import re
 import time
 from typing import List, Optional, Tuple
 
@@ -93,6 +94,14 @@ _TRAINING_GAUGE_KEYS = (
 # because a dead node emits no events to wake the reconciler.
 NODE_LOST_GRACE = 2.0
 NODE_CHECK_PERIOD_S = 2.0
+
+# Elastic resize (RunPolicy.elastic): grace the controller gives the
+# surviving pods to finish their in-flight step and commit a drain
+# checkpoint before the world re-forms at the new size. Past the
+# deadline a still-running old-world pod is hard-deleted (the
+# SIGTERM->SIGKILL escalation). Tests shrink this via monkeypatch.
+RESIZE_DRAIN_GRACE_S = 5.0
+RESIZE_POLL_S = 0.1
 
 
 def _contract_env(pod) -> dict:
@@ -179,6 +188,12 @@ class TPUJobController:
             ("tpujob.preemptions_total", "Gangs evicted for higher priority."),
             ("tpujob.suspensions_total", "Gangs parked by RunPolicy.suspend."),
             ("tpujob.node_lost_pods_total", "Running pods failed via stale node lease."),
+            ("tfk8s_elastic_resizes_total",
+             "Elastic gang resizes, labeled by direction (up/down)."),
+            ("tfk8s_drain_checkpoint_seconds",
+             "Drain-checkpoint commit latency reported by reclaimed workers."),
+            ("tpujob.recovery_seconds",
+             "Seconds from resize start to the resized gang Running."),
             ("gang.free_slices", "Free whole slices per accelerator type."),
             ("tpujob.training.steps_per_sec", "Per-job reported training step rate."),
             ("tpujob.training.step_seconds", "Per-job distribution of step wall time."),
@@ -200,6 +215,16 @@ class TPUJobController:
         self._gang_restarts_floor: dict = {}
         # same stale-cache protection for the preemption counter
         self._preemptions_floor: dict = {}
+        # job key -> (world_version, elastic_replicas) floor: a resize's
+        # status write may not be in the informer cache yet; rendering
+        # off the pre-resize world would recreate the OLD gang size
+        self._elastic_floor: dict = {}
+        # job key -> (start time, direction) of the resize in flight —
+        # closed into the per-job recovery_seconds gauge and the
+        # job.resize trace span when the resized gang reaches Running
+        self._resize_started: dict = {}
+        # job key -> wall time of the last resize (scale-up debounce)
+        self._last_resize: dict = {}
 
     def _enqueue_owner(self, obj) -> None:
         meta = getattr(obj, "obj", obj).metadata  # unwrap DeletedFinalStateUnknown
@@ -236,6 +261,16 @@ class TPUJobController:
                         "tpujob.training.step_seconds",
                         new.status.training["step_seconds"],
                         job_labels,
+                    )
+                # a reclaimed worker reports its drain-checkpoint commit
+                # latency exactly once per drain (runtime/train.py) —
+                # mirror it into the operator histogram
+                drain_s = new.status.training.get("drain_checkpoint_seconds")
+                if drain_s is not None and drain_s != old.status.training.get(
+                    "drain_checkpoint_seconds"
+                ):
+                    self.metrics.observe(
+                        "tfk8s_drain_checkpoint_seconds", drain_s, job_labels
                     )
         if (
             old.metadata.resource_version != new.metadata.resource_version
@@ -274,7 +309,7 @@ class TPUJobController:
             if uid:
                 self.allocator.release(uid)
                 self._export_capacity_gauges()
-            self._prune_evaluator_failures(key)
+            self._prune_job_state(key)
             return
 
         if job.metadata.deletion_timestamp is not None:
@@ -356,6 +391,12 @@ class TPUJobController:
                 self.recorder.event("TPUJob", key, "JobResumed")
             # fall through to ordinary admission: the eviction counter
             # makes the relaunched gang resume from checkpoint
+
+        # Elastic world sizing: admit + render at the EFFECTIVE worker
+        # count (status.elastic_replicas), not the spec-desired one — the
+        # spec stays the user's intent, the status carries the resize.
+        self._clamp_elastic_floor(job)
+        self._apply_elastic_override(job)
 
         # Gang admission (SURVEY.md §7 hard part 1)
         ga = self.allocator.admit(job)
@@ -703,6 +744,15 @@ class TPUJobController:
             }
         self._check_node_liveness(job, observed)
 
+        # Elastic / reclaim handling runs BEFORE the orphan and
+        # stale-render deletions: a resize manages its own graceful drain
+        # of old-world pods, which the hard-delete paths below would
+        # preempt.
+        if self._handle_elastic(job, observed):
+            return
+        if self._handle_drained(job, observed, desired_names):
+            return
+
         # Orphans (scale-down or stale template): delete pods AND services.
         for pname, pod in observed.items():
             if pname not in desired_names and pod.metadata.deletion_timestamp is None:
@@ -932,6 +982,313 @@ class TPUJobController:
         return False
 
 
+    # ------------------------------------------------------ elastic resize
+
+    def _clamp_elastic_floor(self, job: TPUJob) -> None:
+        """Never act on a world OLDER than one this controller already
+        committed (informer cache may lag the resize's status write)."""
+        floor = self._elastic_floor.get(job.metadata.key)
+        if floor is not None and job.status.world_version < floor[0]:
+            job.status.world_version, job.status.elastic_replicas = floor
+
+    def _apply_elastic_override(self, job: TPUJob) -> None:
+        """Rewrite the WORKING COPY's spec to the effective elastic size:
+        Worker replicas from ``status.elastic_replicas``, and (non-cpu)
+        num_slices/mesh re-derived so the gang-consistency invariant
+        (one process per host, mesh product == chips) holds at the
+        resized world. The stored spec keeps the user's desired count —
+        stashed on the copy for the scale-up path."""
+        w = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if w is None:
+            return
+        job._elastic_desired = w.replicas
+        eff = job.status.elastic_replicas
+        if (
+            job.spec.run_policy.elastic is None
+            or eff is None
+            or eff == w.replicas
+        ):
+            return
+        from tfk8s_tpu.utils import topology as topo
+
+        w.replicas = eff
+        try:
+            info = topo.parse_accelerator(
+                job.spec.tpu.accelerator, job.spec.tpu.topology
+            )
+        except topo.TopologyError:
+            return
+        if info.generation != "cpu" and info.hosts:
+            job.spec.tpu.num_slices = max(eff // info.hosts, 1)
+            if job.spec.mesh is not None and set(job.spec.mesh.axes) == {"data"}:
+                # validation restricts elastic TPU jobs to a pure
+                # data-parallel mesh exactly so this re-derivation is safe
+                job.spec.mesh.axes["data"] = (
+                    info.chips * job.spec.tpu.num_slices
+                )
+
+    @staticmethod
+    def _pod_world(pod: Pod) -> int:
+        try:
+            return int(pod.spec.containers[0].env.get("TFK8S_WORLD_VERSION", "0"))
+        except (ValueError, IndexError):
+            return 0
+
+    def _deliver_drain(self, ns: str, pod: Pod, deadline: float) -> None:
+        """Stamp the reclaim-notice annotation on a pod (idempotent); the
+        kubelet's watch turns it into the entrypoint's soft drain
+        signal."""
+        from tfk8s_tpu.runtime.kubelet import RECLAIM_AT_ANNOTATION, reclaim_patch
+
+        if RECLAIM_AT_ANNOTATION in pod.metadata.annotations:
+            return
+        try:
+            self.cs.pods(ns).patch(pod.metadata.name, reclaim_patch(deadline))
+        except (Conflict, NotFound):
+            pass
+
+    def _handle_elastic(self, job: TPUJob, observed) -> bool:
+        """Elastic world sizing (RunPolicy.elastic). Returns True when
+        this sync is consumed by resize management:
+
+        - a Worker drained (or sits under a reclaim notice) and the
+          survivors still satisfy ``min_replicas`` -> begin a resize DOWN:
+          bump the world version, drain the survivors so they checkpoint
+          at their freshest step, and re-render at the surviving count —
+          no backoff_limit burned;
+        - a resize is in flight -> shepherd old-world pods out (drained/
+          terminal ones deleted, stragglers hard-deleted past the grace
+          deadline) before the new gang renders;
+        - the job runs below its desired size and the debounce elapsed ->
+          resize UP toward the spec count when capacity allows.
+        """
+        el = job.spec.run_policy.elastic
+        if el is None or not job.spec.run_policy.scheduling.gang:
+            return False
+        from tfk8s_tpu.runtime.kubelet import (
+            RECLAIM_AT_ANNOTATION,
+            parse_reclaim_at,
+        )
+        from tfk8s_tpu.utils import topology as topo
+
+        key, ns = job.metadata.key, job.metadata.namespace
+        now = time.time()
+        wv = job.status.world_version
+        live = [
+            p for p in observed.values()
+            if p.metadata.deletion_timestamp is None
+        ]
+
+        # -- resize in flight: old-world pods still present ---------------
+        if wv > 0:
+            stale = [p for p in live if self._pod_world(p) != wv]
+            if stale:
+                for p in stale:
+                    if p.status.phase in (
+                        PodPhase.DRAINED, PodPhase.SUCCEEDED, PodPhase.FAILED
+                    ):
+                        self._delete_pod(ns, p.metadata.name)
+                        continue
+                    if RECLAIM_AT_ANNOTATION not in p.metadata.annotations:
+                        self._deliver_drain(
+                            ns, p, now + RESIZE_DRAIN_GRACE_S
+                        )
+                        continue
+                    # a malformed stamp makes the grace unknowable: treat
+                    # it as already expired rather than waiting forever
+                    deadline = parse_reclaim_at(p)
+                    if deadline is None:
+                        deadline = now
+                    if now >= deadline:
+                        # grace exhausted: SIGKILL equivalent
+                        self._delete_pod(ns, p.metadata.name)
+                self.controller.enqueue_after(key, RESIZE_POLL_S)
+                return True
+
+        try:
+            info = topo.parse_accelerator(
+                job.spec.tpu.accelerator, job.spec.tpu.topology
+            )
+        except topo.TopologyError:
+            return False
+
+        workers = [
+            p for p in live
+            if p.metadata.labels.get(L.REPLICA_TYPE) == ReplicaType.WORKER.value
+        ]
+        victims = [
+            p for p in workers
+            if p.status.phase == PodPhase.DRAINED
+            or RECLAIM_AT_ANNOTATION in p.metadata.annotations
+        ]
+        survivors = [
+            p for p in workers
+            if p not in victims
+            and p.status.phase in (
+                PodPhase.PENDING, PodPhase.SCHEDULED, PodPhase.RUNNING
+            )
+        ]
+        if any(
+            p.status.phase == PodPhase.FAILED
+            and RECLAIM_AT_ANNOTATION not in p.metadata.annotations
+            for p in workers
+        ):
+            # a COLD crash (no notice) in the same sync as a resize
+            # trigger: defer the resize so the ordinary failure machinery
+            # accounts it first (backoff, restart floor, events) — a
+            # world-version bump here would reclassify the carcass as a
+            # stale-world pod and the shepherd would delete it silently,
+            # exempting crashes from backoff whenever they coincide with
+            # a resize window
+            return False
+
+        # -- resize down: capacity left; shrink to the survivors ----------
+        if victims:
+            new_count = len(survivors)
+            if info.generation != "cpu" and info.hosts:
+                # slice granularity: a partially-populated slice cannot
+                # run — floor to the slice boundary
+                new_count = (new_count // info.hosts) * info.hosts
+            if new_count >= max(el.min_replicas or 1, 1):
+                self._begin_resize(
+                    job, new_count, "down",
+                    drain_pods=[p for p in live if p not in victims],
+                    delete_pods=[
+                        p for p in victims
+                        if p.status.phase == PodPhase.DRAINED
+                    ],
+                )
+                return True
+            # below min_replicas: fall through — _handle_drained answers
+            # with a preemption-style whole-gang restart (re-admission at
+            # full size when capacity returns)
+            return False
+
+        # -- debounced scale back up toward the desired count -------------
+        eff = job.status.elastic_replicas
+        desired = getattr(job, "_elastic_desired", None)
+        if eff is None or desired is None or eff >= desired:
+            return False
+        debounce = el.resize_debounce_s or 0.0
+        remaining = debounce - (now - self._last_resize.get(key, 0.0))
+        if remaining > 0:
+            self.controller.enqueue_after(key, min(remaining + 0.05, debounce))
+            return False  # keep running at the current size meanwhile
+        target = min(desired, el.max_replicas or desired)
+        if info.generation != "cpu" and info.hosts:
+            extra_slices = -(-(target - eff) // info.hosts)  # ceil
+            if self.allocator.free_slices(job.spec.tpu.accelerator) < extra_slices:
+                self.controller.enqueue_after(key, PENDING_REQUEUE_S)
+                return False  # capacity hasn't returned yet
+        self._begin_resize(job, target, "up", drain_pods=live, delete_pods=[])
+        return True
+
+    def _begin_resize(
+        self, job: TPUJob, new_count: int, direction: str,
+        drain_pods: List[Pod], delete_pods: List[Pod],
+    ) -> None:
+        """Commit the resize decision: new world version + effective count
+        in status FIRST (conflict -> the re-enqueued sync redoes the
+        accounting off fresh state), then drain every pod of the old
+        world so each commits a checkpoint at its freshest step before
+        the gang re-forms."""
+        key, ns = job.metadata.key, job.metadata.namespace
+        desired = getattr(job, "_elastic_desired", None) or new_count
+        job.status.elastic_replicas = None if new_count == desired else new_count
+        job.status.world_version += 1
+        wv = job.status.world_version
+        helpers.set_condition(
+            job.status, JobConditionType.RESTARTING,
+            reason="Resizing",
+            message=f"{direction} to {new_count} workers (world v{wv})",
+        )
+        if not self._write_status(job):
+            return
+        self._elastic_floor[key] = (wv, job.status.elastic_replicas)
+        now = time.time()
+        self._last_resize[key] = now
+        self._resize_started[key] = (now, direction)
+        self.recorder.event(
+            "TPUJob", key, "ElasticResize",
+            f"{direction} -> {new_count} workers (world v{wv})",
+        )
+        self.metrics.inc(
+            "tfk8s_elastic_resizes_total", 1.0, {"direction": direction}
+        )
+        for p in delete_pods:
+            self._delete_pod(ns, p.metadata.name)
+        deadline = now + RESIZE_DRAIN_GRACE_S
+        for p in drain_pods:
+            self._deliver_drain(ns, p, deadline)
+        self.controller.enqueue_after(key, RESIZE_POLL_S)
+
+    def _handle_drained(self, job: TPUJob, observed, desired_names) -> bool:
+        """Drained pods NOT consumed by an elastic resize. A drained
+        compute pod on a fixed-size gang (or with survivors below
+        min_replicas) is a whole-gang preemption-style restart: reclaim
+        is not a failure, so ``backoff_limit`` is untouched and the
+        relaunched gang resumes from the drain checkpoint. Drained
+        evaluators / per-pod-mode pods are simply replaced."""
+        key = job.metadata.key
+        drained_gang: List[Pod] = []
+        for p in observed.values():
+            if (
+                p.status.phase != PodPhase.DRAINED
+                or p.metadata.deletion_timestamp is not None
+            ):
+                continue
+            is_eval = (
+                p.metadata.labels.get(L.REPLICA_TYPE)
+                == ReplicaType.EVALUATOR.value
+            )
+            if (
+                p.metadata.name not in desired_names
+                or is_eval
+                or not job.spec.run_policy.scheduling.gang
+            ):
+                # outside the gang contract: replace in place, no
+                # accounting (a fresh pod re-runs from checkpoint or
+                # from its own poll loop)
+                self._delete_pod(job.metadata.namespace, p.metadata.name)
+                continue
+            drained_gang.append(p)
+        if not drained_gang:
+            return False
+        ids = sorted(
+            f"{p.metadata.name}:{p.metadata.uid[:8]}" for p in drained_gang
+        )
+        existing = helpers.get_condition(
+            job.status, JobConditionType.RESTARTING
+        )
+        already = (
+            existing is not None
+            and existing.message
+            == self._reclaim_restart_message(job.status.preemptions, ids)
+        )
+        if already:
+            self._delete_job_pods(job, only_phases=None)
+            return True
+        job.status.preemptions += 1
+        helpers.set_condition(
+            job.status, JobConditionType.RESTARTING,
+            reason="Reclaimed",
+            message=self._reclaim_restart_message(job.status.preemptions, ids),
+        )
+        if not self._write_status(job):
+            return True
+        self._preemptions_floor[key] = job.status.preemptions
+        self.recorder.event(
+            "TPUJob", key, "ReclaimRestart",
+            f"#{job.status.preemptions} after {ids} drained",
+        )
+        self.metrics.inc("tpujob.preemptions_total")
+        self._delete_job_pods(job, only_phases=None)
+        return True
+
+    @staticmethod
+    def _reclaim_restart_message(n: int, ids: List[str]) -> str:
+        return f"reclaim restart {n} after {ids} drained"
+
     def _export_capacity_gauges(self) -> None:
         """Free whole-slice inventory per accelerator type, as gauges.
         Cheap when nothing changed: the allocator's version counter
@@ -956,17 +1313,33 @@ class TPUJobController:
         self._evaluator_failures_seen.add(entry)
         self.recorder.event("TPUJob", key, "EvaluatorFailed", pod.metadata.name)
 
-    def _prune_evaluator_failures(self, key: str) -> None:
-        """Drop all controller-side memory for a deleted job (evaluator
-        failure dedup + gang-restart/preemption floors) — a future job
-        reusing the name must not inherit a stale floor (it would render
+    def _prune_job_state(self, key: str) -> None:
+        """Drop ALL controller-side scratch for a deleted job (evaluator
+        failure dedup, restart/preemption/elastic floors, resize clocks,
+        pending per-pod restart lineage) — a future job reusing the name
+        must not inherit a stale floor (it would render
         TFK8S_GANG_RESTARTS > 0 and try to resume a checkpoint that
-        isn't its own)."""
+        isn't its own), and a long-lived operator must not leak one map
+        entry per job it ever saw."""
         self._evaluator_failures_seen = {
             e for e in self._evaluator_failures_seen if e[0] != key
         }
         self._gang_restarts_floor.pop(key, None)
         self._preemptions_floor.pop(key, None)
+        self._elastic_floor.pop(key, None)
+        self._resize_started.pop(key, None)
+        self._last_resize.pop(key, None)
+        # _pending_restart_counts is keyed by POD key; a pod belongs to
+        # this job iff it matches <ns>/<job>-<replica-type>-<index> (exact
+        # pattern, not a prefix — job "a" must not prune pods of job
+        # "a-worker", whose names continue past the digits)
+        ns, name = key.split("/", 1)
+        types = "|".join(rt.value.lower() for rt in ReplicaType)
+        pat = re.compile(
+            rf"^{re.escape(ns)}/{re.escape(name)}-(?:{types})-\d+$"
+        )
+        for pkey in [k for k in self._pending_restart_counts if pat.match(k)]:
+            self._pending_restart_counts.pop(pkey, None)
 
     def _delete_pod(self, ns: str, name: str) -> None:
         try:
@@ -1059,6 +1432,25 @@ class TPUJobController:
                 ):
                     self.recorder.event("TPUJob", key, "JobRunning")
                     changed = True
+                started = self._resize_started.pop(key, None)
+                if started is not None:
+                    # the resized gang is fully Running: close the resize
+                    # into the per-job recovery gauge + one trace span
+                    t0, direction = started
+                    end = time.time()
+                    self.metrics.set_gauge(
+                        "tpujob.recovery_seconds", end - t0,
+                        {"namespace": job.metadata.namespace,
+                         "job": job.metadata.name},
+                    )
+                    self.tracer.record_span(
+                        "job.resize", start=t0, end=end,
+                        attributes={"job": key, "direction": direction},
+                    )
+                    self.recorder.event(
+                        "TPUJob", key, "ResizeComplete",
+                        f"{direction} recovered in {end - t0:.2f}s",
+                    )
 
         if changed:
             self._write_status(job)
@@ -1168,7 +1560,7 @@ class TPUJobController:
         self._delete_job_services(job)
         self.allocator.release(job.metadata.uid)
         self._export_capacity_gauges()
-        self._prune_evaluator_failures(key)
+        self._prune_job_state(key)
         if FINALIZER in job.metadata.finalizers:
             remaining = [f for f in job.metadata.finalizers if f != FINALIZER]
             try:
