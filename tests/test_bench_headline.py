@@ -71,6 +71,26 @@ FULL_SERVING_BLOCK = {
 }
 
 
+FULL_GEN_SERVING_BLOCK = {
+    "gen_serving_model": "gpt-mid",
+    "gen_slots": 8,
+    "gen_page_size": 16,
+    "gen_max_pages": 192,
+    "gen_requests": 96,
+    "gen_useful_tokens": 3657,
+    "gen_tokens_per_s": 1234.5,
+    "gen_wall_s": 2.963,
+    "tpot_p50_ms": 41.2,
+    "tpot_p99_ms": 210.7,
+    "gen_mean_live_slots": 7.69,
+    "gen_prefix_cache_hits": 43,
+    "gen_tokens_per_s_baseline": 456.7,
+    "gen_wall_s_baseline": 8.01,
+    "tpot_p99_ms_baseline": 626.1,
+    "gen_speedup_vs_batch": 2.7,
+}
+
+
 FULL_RECOVERY_BLOCK = {
     "recovery_workers": 4,
     "recovery_min_replicas": 2,
@@ -88,7 +108,7 @@ FULL_RECOVERY_BLOCK = {
 def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
-        FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK,
+        FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -100,6 +120,7 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert "noise" not in parsed["extra"]
     assert "serving_sweep" not in parsed["extra"]
     assert "recovery_samples_s" not in parsed["extra"]
+    assert "gen_useful_tokens" not in parsed["extra"]
     # the driver's acceptance keys survive at normal sizes
     assert parsed["extra"]["img_per_sec_native"] == 1030.1
     assert parsed["extra"]["serving_qps"] == 2310.4
@@ -108,6 +129,11 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert parsed["extra"]["recovery_p50_s"] == 2.03
     assert parsed["extra"]["recovery_p99_s"] == 2.45
     assert parsed["extra"]["recovery_backoff_burned"] == 0
+    # ISSUE-7 generative acceptance keys
+    assert parsed["extra"]["gen_tokens_per_s"] == 1234.5
+    assert parsed["extra"]["tpot_p99_ms"] == 210.7
+    assert parsed["extra"]["gen_speedup_vs_batch"] == 2.7
+    assert parsed["extra"]["gen_tokens_per_s_baseline"] == 456.7
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -117,7 +143,7 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     fat["degraded_sections"] = [f"section_{i:03d}" for i in range(60)]
     line = bench.build_headline(
         _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
-        FULL_RECOVERY_BLOCK,
+        FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -133,18 +159,21 @@ def test_headline_without_image_block():
     assert "image_backend" not in parsed["extra"]
     assert "serving_qps" not in parsed["extra"]
     assert "recovery_p50_s" not in parsed["extra"]
+    assert "gen_tokens_per_s" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
 def test_serving_keys_in_drop_order():
-    """Every serving/recovery headline key must appear in the degrade
-    order — a key outside it could hold the line over the ceiling
-    forever."""
+    """Every serving/recovery/generative headline key must appear in the
+    degrade order — a key outside it could hold the line over the
+    ceiling forever."""
     import inspect
 
     src = inspect.getsource(bench.build_headline)
     for key in ("serving_qps", "serving_p50_ms", "serving_p99_ms",
                 "serving_batch_occupancy", "serving_model",
                 "recovery_p50_s", "recovery_p99_s",
-                "recovery_backoff_burned"):
+                "recovery_backoff_burned",
+                "gen_tokens_per_s", "tpot_p99_ms",
+                "gen_speedup_vs_batch", "gen_tokens_per_s_baseline"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

@@ -47,6 +47,14 @@ def test_default_scope_covers_hotpath_counters():
         # e2e assert against these exact names
         "tfk8s_elastic_resizes_total": False,
         "tfk8s_drain_checkpoint_seconds": False,
+        # ISSUE-7 continuous-batching series: per-token observability of
+        # the decode loop — the generative bench arm and the decode-loop
+        # tests key off these exact names
+        "tfk8s_serving_tokens_total": False,
+        "tfk8s_serving_tpot_seconds": False,
+        "tfk8s_serving_slot_occupancy": False,
+        "tfk8s_serving_page_occupancy": False,
+        "tfk8s_serving_prefix_cache_hits_total": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

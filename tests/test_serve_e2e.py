@@ -368,14 +368,32 @@ class TestAutoscaler:
 
         t = threading.Thread(target=watch_floor, daemon=True)
         t.start()
-        # knock out the retained index-0 replica and shrink in the same
-        # breath: its recreation is briefly not-ready while the extras
-        # (indices 1, 2) are the only Ready pods
+        # knock out the retained index-0 replica, then shrink: its
+        # recreation is briefly not-ready while the extras (indices 1, 2)
+        # are the only Ready pods
         pods, _ = cs.pods().list(label_selector=L.serve_selector("shrink-s"))
         idx0 = next(
             p for p in pods if p.metadata.labels[L.REPLICA_INDEX] == "0"
         )
         cs.pods().delete(idx0.metadata.name)
+        # Deterministic barrier (deflake, ISSUE 7 satellite): wait until
+        # the CONTROLLER has observed the delete — proven by it creating
+        # the replacement pod (a different uid at index 0; the reconciler
+        # only renders a replacement once the old pod left its live set).
+        # Without this, a shrink patch racing the controller's stale
+        # informer view could count the deleted idx-0 as still Ready and
+        # release both ready extras in one pass (~1/13 under load).
+        assert wait_for(
+            lambda: any(
+                p.metadata.labels[L.REPLICA_INDEX] == "0"
+                and p.metadata.uid != idx0.metadata.uid
+                and p.metadata.deletion_timestamp is None
+                for p in cs.pods().list(
+                    label_selector=L.serve_selector("shrink-s")
+                )[0]
+            ),
+            timeout=30,
+        ), "controller never replaced the deleted idx-0 replica"
         cs.tpuserves().patch("shrink-s", {"spec": {"replicas": 1}})
         assert wait_for(
             lambda: ready_count(cs, "shrink-s") == 1
@@ -441,6 +459,97 @@ class TestAutoscaler:
             pods.append(p)
         ctrl._autoscale(cs.tpuserves().get("frac-s"), pods)
         assert cs.tpuserves().get("frac-s").spec.replicas == 8
+
+
+class TestDecodeLoopE2E:
+    """ISSUE-7 acceptance, through the whole stack: a generative TPUServe
+    is reconciled into replicas running the continuous-batching decode
+    loop; a later-admitted short request completes BEFORE an earlier long
+    row (eos/budget-retired slots are reused mid-batch), and over-long
+    prompts surface as the typed client-visible error."""
+
+    def make_gpt_serve(self, name, size="tiny", page_size=8, max_pages=64,
+                       **spec_kw):
+        serve = TPUServe(
+            metadata=ObjectMeta(name=name),
+            spec=TPUServeSpec(
+                task="gpt",
+                checkpoint="seed:0",
+                replicas=1,
+                batching=BatchingPolicy(
+                    max_batch_size=4, batch_timeout_ms=2.0, queue_limit=64,
+                    page_size=page_size, max_pages=max_pages,
+                ),
+                **spec_kw,
+            ),
+        )
+        serve.spec.template.env["TFK8S_SERVE_GEN_TOKENS"] = "8"
+        serve.spec.template.env["TFK8S_SERVE_GPT_SIZE"] = size
+        return serve
+
+    def test_decode_loop_serves_and_reuses_slots_mid_batch(self, cluster):
+        import numpy as np
+
+        cs, ctrl, stop = cluster
+        # the MID model: its decode step is slow enough (~5 ms on this
+        # box) that a 120-token generation is provably in flight while
+        # the short request runs — the tiny model finishes before any
+        # observer thread can interleave
+        cs.tpuserves().create(
+            self.make_gpt_serve("gpt-loop-s", size="mid", page_size=16)
+        )
+        assert wait_for(lambda: ready_count(cs, "gpt-loop-s") == 1, timeout=120)
+
+        client = ServeClient(cs, "gpt-loop-s")
+        rng = np.random.default_rng(0)
+        done = []
+        lock = threading.Lock()
+
+        def run(name, n, g):
+            out = client.request(
+                {"tokens": rng.integers(1, 256, size=n).astype(np.int32),
+                 "gen_tokens": g},
+                timeout=120,
+            )
+            with lock:
+                done.append((name, len(out["tokens"])))
+
+        def live_slots_reported():
+            pods, _ = cs.pods().list(
+                label_selector=L.serve_selector("gpt-loop-s")
+            )
+            return any(
+                p.status.training.get("serving_live_slots", 0) >= 1
+                for p in pods
+            )
+
+        with ThreadPoolExecutor(4) as ex:
+            long_f = ex.submit(run, "long", 10, 120)
+            # barrier: the long row is ADMITTED and decoding (the server
+            # publishes live-slot occupancy through the kubelet flush)
+            assert wait_for(live_slots_reported, timeout=60)
+            short_f = ex.submit(run, "short", 5, 2)
+            short_f.result(timeout=120)
+            long_f.result(timeout=120)
+        # the short request, admitted while the long row held a slot,
+        # finished first — batch-granularity scheduling cannot do this
+        assert [n for n, _ in done] == ["short", "long"]
+        assert dict(done)["short"] == 2 and dict(done)["long"] == 120
+
+    def test_overlong_prompt_is_typed_client_error(self, cluster):
+        import numpy as np
+
+        from tfk8s_tpu.runtime.server import InvalidRequest
+
+        cs, ctrl, stop = cluster
+        cs.tpuserves().create(self.make_gpt_serve("gpt-inv-s"))
+        assert wait_for(lambda: ready_count(cs, "gpt-inv-s") == 1, timeout=60)
+        client = ServeClient(cs, "gpt-inv-s")
+        with pytest.raises(InvalidRequest):
+            client.request(
+                {"tokens": np.ones(60, np.int32), "gen_tokens": 30},
+                timeout=30,
+            )
 
 
 class TestConditions:

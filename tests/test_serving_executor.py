@@ -296,3 +296,64 @@ class TestOccupancy:
         assert report["serving_ready"] == 1.0
         assert report["serving_batch_occupancy"] == s.mean_batch_occupancy
         assert s.drain()
+
+
+class TestInvalidRequests:
+    """ISSUE-7 satellite: an unservable-by-contract request (e.g. a
+    prompt whose generation budget overflows max_len) is a TYPED,
+    client-visible InvalidRequest with its own outcome label — not a
+    bare TypeError that reads as a malformed payload."""
+
+    class OverlongModel(ServedModel):
+        version = "v"
+
+        def load(self):
+            pass
+
+        def bucket_of(self, payload):
+            from tfk8s_tpu.runtime.server import InvalidRequest
+
+            if payload == "overlong":
+                raise InvalidRequest("prompt exceeds max_len")
+            if payload == "malformed":
+                raise TypeError("not a token array")
+            return "b"
+
+        def forward(self, payloads):
+            return [("ok", p) for p in payloads]
+
+    def test_invalid_counts_its_own_outcome_and_reraises(self):
+        from tfk8s_tpu.runtime.server import InvalidRequest
+
+        m = Metrics()
+        s = make_server(self.OverlongModel(), metrics=m)
+        with pytest.raises(InvalidRequest):
+            s.submit("overlong", timeout=1)
+        assert m.get_counter(
+            "tfk8s_serving_requests_total", {"outcome": "invalid"}
+        ) == 1.0
+        # malformed payloads stay TypeError and are NOT counted invalid
+        with pytest.raises(TypeError):
+            s.submit("malformed", timeout=1)
+        assert m.get_counter(
+            "tfk8s_serving_requests_total", {"outcome": "invalid"}
+        ) == 1.0
+        # the executor still serves after rejecting
+        assert s.submit("fine", timeout=5) == ("ok", "fine")
+        assert s.drain()
+
+    def test_gpt_generator_overlong_is_invalid(self):
+        """The real GptGenerator raises the typed error from bucket_of
+        once prompt + gen_tokens exceeds the model's max_len."""
+        import numpy as np
+
+        from tfk8s_tpu.runtime.server import GptGenerator, InvalidRequest
+
+        g = GptGenerator("seed:0", max_batch_size=2, gen_tokens=16,
+                         size="tiny")
+        g.load()  # params only; no forward compile needed for bucket_of
+        assert g.bucket_of(np.ones(8, np.int32)) == ("gpt", 8)
+        with pytest.raises(InvalidRequest):
+            g.bucket_of(np.ones(60, np.int32))  # 60 + 16 > max_len 64
+        with pytest.raises(TypeError):
+            g.bucket_of(np.ones((2, 2), np.int32))  # malformed stays TypeError

@@ -340,11 +340,22 @@ class BatchingPolicy:
     ``max_batch_size`` or after ``batch_timeout_ms`` — whichever first —
     and the request queue is bounded at ``queue_limit``; past it, submits
     shed with the typed overload error instead of queuing unboundedly
-    (Clipper-style adaptive batching under a latency SLO)."""
+    (Clipper-style adaptive batching under a latency SLO).
+
+    Generative tasks run the continuous-batching decode loop instead:
+    ``max_batch_size`` becomes the decode SLOT capacity (rows admitted
+    and retired at token granularity), and the block-paged KV cache is
+    sized by ``page_size`` (tokens per page) × ``max_pages`` (pool
+    pages, one reserved as the trash page) — admission is gated on the
+    pool covering a request's worst-case prompt + generation budget, so
+    out-of-pages stalls admission and never corrupts live rows."""
 
     max_batch_size: int = 8
     batch_timeout_ms: float = 10.0
     queue_limit: int = 128
+    # block-paged KV cache (decode loop only; ignored by classifiers)
+    page_size: int = 16
+    max_pages: int = 256
 
 
 @dataclass

@@ -207,6 +207,16 @@ def validate_serve(serve: TPUServe) -> List[str]:
             f"spec.batching.queueLimit: must be >= maxBatchSize "
             f"({b.max_batch_size}), got {b.queue_limit}"
         )
+    if b.page_size < 1:
+        errs.append(f"spec.batching.pageSize: must be >= 1, got {b.page_size}")
+    if b.max_pages < 2:
+        # page 0 is the reserved trash page — a pool of 1 could never
+        # admit anything (the model-side max_len fit is checked at
+        # replica startup, where max_len is known)
+        errs.append(
+            f"spec.batching.maxPages: must be >= 2 (trash page + 1 usable), "
+            f"got {b.max_pages}"
+        )
 
     ru = spec.rolling_update
     if ru.max_surge < 0 or ru.max_unavailable < 0:

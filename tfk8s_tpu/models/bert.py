@@ -57,6 +57,10 @@ class BertWithHead(nn.Module):
     # sow per-layer K/V into "kv_cache" during a full forward — batched
     # prefill support (models/gpt.prefill_cache)
     sow_kv: bool = False
+    # block-paged KV cache (transformer.MultiHeadAttention paged path):
+    # K/V in a shared page pool addressed by per-row page tables, the
+    # continuous-batching decode loop's substrate (models/gpt.decode_step_packed)
+    paged: bool = False
 
     def setup(self):
         self.embed = Embedder(self.cfg, name="embed")
@@ -69,6 +73,7 @@ class BertWithHead(nn.Module):
                 causal=self.causal,
                 decode=self.decode,
                 sow_kv=self.sow_kv,
+                paged=self.paged,
                 name=f"layer{i}",
             )
             for i in range(self.cfg.num_layers)
@@ -80,10 +85,18 @@ class BertWithHead(nn.Module):
         ids: jax.Array,
         mask: Optional[jax.Array] = None,
         pos_offset: Optional[jax.Array] = None,
+        page_tables: Optional[jax.Array] = None,
     ) -> jax.Array:
+        # in paged mode pos_offset is the per-row position vector; it
+        # feeds BOTH the positional gather and the attention page math
         x = self.embed(ids, pos_offset=pos_offset)
         for layer in self.layers:
-            x = layer(x, mask)
+            if self.paged:
+                x = layer(
+                    x, mask, page_tables=page_tables, positions=pos_offset
+                )
+            else:
+                x = layer(x, mask)
         x = self.ln_final(x).astype(self.cfg.dtype)
         return self.embed.logits(x)  # [b, l, vocab], fp32
 

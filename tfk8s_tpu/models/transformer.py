@@ -83,6 +83,16 @@ class TransformerConfig:
     # table is a PARAM shaped [max_len, embed] (trained checkpoints pin
     # it), while the cache is ephemeral serving state.
     decode_cache_len: Optional[int] = None
+    # Block-paged KV cache (the vLLM/PagedAttention layout, served by the
+    # continuous-batching decode loop in runtime/server.py): each layer's
+    # K/V live in a pool of ``kv_max_pages`` fixed ``kv_page_size``-token
+    # pages; a request's cache is a per-slot PAGE TABLE into the pool, so
+    # long- and short-context requests share HBM without fragmentation
+    # and prompts of DIFFERENT lengths ride one compiled step. Both must
+    # be set for ``paged=True`` modules; page 0 is reserved as the trash
+    # page inactive slots write into (runtime/paging.PageAllocator).
+    kv_page_size: Optional[int] = None
+    kv_max_pages: Optional[int] = None
     # False drops the flax Partitioned boxes from layer params. Needed
     # inside manual-collective regions (shard_map pipeline stages): flax
     # re-runs initializers under eval_shape at apply time, and a boxed
@@ -90,6 +100,12 @@ class TransformerConfig:
     # manual mesh doesn't have (models/pipelined.py shards stage params
     # over ``pipeline`` via the stage-stacking rebox instead).
     partition_params: bool = True
+
+    def pages_per_slot(self) -> int:
+        """Page-table width: pages needed to cover ``max_len`` tokens."""
+        if not self.kv_page_size:
+            raise ValueError("kv_page_size is unset; not a paged config")
+        return -(-self.max_len // self.kv_page_size)
 
     def layer_uses_moe(self, layer_idx: int) -> bool:
         """MoE layers interleave dense ones (every ``moe_every``-th layer,
@@ -133,6 +149,11 @@ class MultiHeadAttention(nn.Module):
     # over the prompt and seeds the decode cache from the sown values
     # instead of paying prompt_len single-token steps
     sow_kv: bool = False
+    # block-paged KV cache (continuous batching): K/V live in a shared
+    # page pool (the "pages" collection), addressed through per-row page
+    # tables — one compiled step serves every prompt length and rows
+    # admit/retire independently (models/gpt.decode_step_packed)
+    paged: bool = False
 
     @nn.compact
     def __call__(
@@ -140,6 +161,8 @@ class MultiHeadAttention(nn.Module):
         x: jax.Array,
         kv: Optional[jax.Array] = None,
         mask: Optional[jax.Array] = None,
+        page_tables: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.cfg
         kv = x if kv is None else kv
@@ -153,7 +176,71 @@ class MultiHeadAttention(nn.Module):
             self.sow("kv_cache", "prefill_k", k)
             self.sow("kv_cache", "prefill_v", v)
 
-        if self.decode:
+        if self.paged:
+            # -- block-paged incremental attention ------------------------
+            # One call serves BOTH shapes of the continuous-batching loop:
+            # decode ([slots, 1] — every live slot one token) and chunked
+            # prefill ([1, chunk] — one request's prompt slice), so the
+            # whole mixed-length workload compiles exactly twice. Row r's
+            # token t sits at absolute position positions[r] + t; its K/V
+            # are scattered into page page_tables[r, p // page_size] at
+            # offset p % page_size, and attention gathers the row's whole
+            # page list back into a [rows, pages*page_size, h, d] view
+            # masked to the filled prefix. Inactive rows point their page
+            # table at the reserved trash page 0 (runtime/paging), so
+            # their writes can never corrupt a live row.
+            if mask is not None:
+                raise ValueError(
+                    "paged mode computes its own prefix mask; feed "
+                    "unpadded per-row token slices (mask=None)"
+                )
+            if page_tables is None or positions is None:
+                raise ValueError("paged mode needs page_tables and positions")
+            ps, n_pages = cfg.kv_page_size, cfg.kv_max_pages
+            if not ps or not n_pages:
+                raise ValueError(
+                    "paged mode needs cfg.kv_page_size and cfg.kv_max_pages"
+                )
+            b, step_len, h, d = k.shape
+            k_pages = self.variable(
+                "pages", "k_pages", jnp.zeros, (n_pages * ps, h, d), k.dtype
+            )
+            v_pages = self.variable(
+                "pages", "v_pages", jnp.zeros, (n_pages * ps, h, d), v.dtype
+            )
+            mpp = page_tables.shape[1]
+            pos = positions[:, None] + jnp.arange(step_len)  # [b, T] absolute
+            # a position past the table must write the TRASH page (0) —
+            # merely clamping the page column would land the write in
+            # the row's LAST real page and overwrite live prompt K/V
+            # (e.g. a prefix-cache hit whose final prefill chunk pads
+            # past max_len); the overflowing row's OUTPUT is poisoned
+            # below (same contract as the contiguous path's
+            # buffer-overflow NaN)
+            page_col = jnp.minimum(pos // ps, mpp - 1)
+            page_id = jnp.take_along_axis(page_tables, page_col, axis=1)
+            page_id = jnp.where(pos < mpp * ps, page_id, 0)
+            flat = (page_id * ps + pos % ps).reshape(-1)  # rows of the pool
+            kp = k_pages.value.at[flat].set(k.reshape(-1, h, d))
+            vp = v_pages.value.at[flat].set(v.reshape(-1, h, d))
+            k_pages.value, v_pages.value = kp, vp
+            # gather each row's pages back as one contiguous-looking view
+            rows = (
+                (page_tables * ps)[:, :, None] + jnp.arange(ps)[None, None, :]
+            ).reshape(b, mpp * ps)
+            k_all = jnp.take(kp, rows, axis=0)  # [b, mpp*ps, h, d]
+            v_all = jnp.take(vp, rows, axis=0)
+            # token t sees gathered position j iff j <= positions[r] + t —
+            # the causal mask in page-table coordinates (page k of the
+            # table covers absolute positions [k*ps, (k+1)*ps))
+            visible = (
+                jnp.arange(mpp * ps)[None, None, :] <= pos[:, :, None]
+            )
+            out = dot_product_attention(q, k_all, v_all, mask=visible)
+            out = jnp.where(
+                (pos < mpp * ps)[:, :, None, None], out, jnp.nan
+            )
+        elif self.decode:
             b, step_len, h, d = k.shape
             # token-at-a-time generation: a multi-token decode step would
             # need an intra-step causal mask this path deliberately omits
@@ -274,15 +361,23 @@ class EncoderLayer(nn.Module):
     causal: bool = False
     decode: bool = False
     sow_kv: bool = False
+    paged: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        mask: Optional[jax.Array] = None,
+        page_tables: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
         cfg = self.cfg
         h = _ln("ln_attn", cfg.ln_eps)(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
             cfg, causal=self.causal, attn_fn=self.attn_fn,
-            decode=self.decode, sow_kv=self.sow_kv, name="attn"
-        )(h, mask=mask)
+            decode=self.decode, sow_kv=self.sow_kv, paged=self.paged,
+            name="attn"
+        )(h, mask=mask, page_tables=page_tables, positions=positions)
         h = _ln("ln_mlp", cfg.ln_eps)(x).astype(cfg.dtype)
         if self.use_moe:
             from tfk8s_tpu.parallel.moe import SwitchMoeBlock
@@ -392,9 +487,15 @@ class Embedder(nn.Module):
         # rematerialization (observed on dp×fsdp×tp meshes).
         # ``pos_offset`` (possibly traced) shifts the positional slice —
         # incremental decode feeds one token at absolute position offset.
+        # A VECTOR pos_offset ([b]) gives each row its own offset: the
+        # paged decode loop steps slots that sit at different absolute
+        # positions in one dispatch (gather instead of a shared slice).
         def pos_slice(pos):
             if pos_offset is None:
                 return pos[: ids.shape[-1]]
+            if getattr(pos_offset, "ndim", 0) >= 1:
+                rows = pos_offset[:, None] + jnp.arange(ids.shape[-1])
+                return jnp.take(pos, rows, axis=0)  # [b, l, embed]
             return jax.lax.dynamic_slice_in_dim(
                 pos, pos_offset, ids.shape[-1], axis=0
             )

@@ -1,0 +1,250 @@
+"""Host-side page accounting for the block-paged KV cache.
+
+The device half (models/gpt.decode_step_packed / prefill_into_slots) is a pure
+function over a preallocated page pool and per-slot page tables; THIS
+module owns which page holds what:
+
+- :class:`PageAllocator` hands out fixed-size pages from the pool,
+  reserves a request's worst-case page budget at admission (so a live
+  request can always grow its page table mid-generation — out-of-pages
+  can stall ADMISSION, never corrupt a row that already started), and
+  recycles pages when requests retire.
+- The **prefix cache**: page-aligned prompt prefixes are content-hashed
+  per page (a digest CHAIN, so a page's identity includes everything
+  before it) and kept after release. A new request whose prompt starts
+  with a cached chain reuses those pages copy-on-write: shared pages are
+  never written again — a reused prefix always ends on a page boundary
+  and the remainder (at least the prompt's final token, which must be
+  re-run to produce the first output logits) lands in freshly allocated
+  pages, so divergence allocates instead of mutating. Idle cached pages
+  are reclaimed LRU-first when the free list runs dry.
+
+Page 0 is the TRASH page: never allocated, the write target of inactive
+decode slots (zero-filled page tables). Everything here is plain Python
+under the executor's lock — no jax, unit-testable in microseconds
+(tests/test_paged_kv.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: reserved write target for inactive slots; never handed out
+TRASH_PAGE = 0
+
+
+class OutOfPages(Exception):
+    """The pool cannot cover a new request's worst-case page budget.
+    Admission-time only: the caller keeps the request queued and retries
+    after retirements free pages."""
+
+
+@dataclass
+class SlotLease:
+    """One admitted request's page holdings: ``pages`` in table order
+    (cached prefix first), plus the unallocated remainder of its
+    reserved budget."""
+
+    pages: List[int] = field(default_factory=list)
+    #: leading entries of ``pages`` reused from the prefix cache —
+    #: shared, read-only; the executor never writes positions below
+    #: ``cached_pages * page_size``
+    cached_pages: int = 0
+    #: pages this lease may still draw on demand (reserved at admission)
+    reserved: int = 0
+
+
+class PageAllocator:
+    """Fixed pool of ``num_pages`` pages of ``page_size`` tokens.
+
+    Contract (tests/test_paged_kv.py):
+
+    - :meth:`admit` either returns a lease whose reservation covers the
+      request's WORST-CASE length (prompt + generation budget) or raises
+      :class:`OutOfPages` — a live lease's :meth:`extend` therefore
+      always succeeds;
+    - pages released by a retiring lease are reusable immediately;
+      cache-registered pages stay resident (evictable LRU) so later
+      requests with the same prompt prefix skip their prefill;
+    - a cached page is shared by refcount and never freed while any
+      lease holds it.
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 prefix_cache: bool = True):
+        if num_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (trash + 1 usable), got {num_pages}"
+            )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.prefix_cache_enabled = prefix_cache
+        self._free: deque = deque(range(1, num_pages))
+        self._ref: Dict[int, int] = {}
+        self._reserved_total = 0
+        # digest-chain key -> page id, LRU order (oldest first); a cached
+        # page with refcount 0 is idle storage, evictable on demand
+        self._cache: "OrderedDict[str, int]" = OrderedDict()
+        self._page_key: Dict[int, str] = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        """Pages immediately on the free list (excludes evictable cache)."""
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        """Pages held by leases OR idle in the prefix cache."""
+        return self.num_pages - 1 - len(self._free)
+
+    def available(self) -> int:
+        """Pages a NEW admission may still claim: free + evictable cached
+        idle pages, minus what live leases have reserved but not drawn."""
+        idle = sum(1 for p in self._cache.values() if not self._ref.get(p))
+        return len(self._free) + idle - self._reserved_total
+
+    # -- prefix cache -------------------------------------------------------
+
+    def _page_digests(self, tokens: Sequence[int], upto: int) -> List[str]:
+        """Chained content digests for the first ``upto`` full pages.
+        Tokens are normalized to plain ints so a numpy prompt and a list
+        prompt with the same content hash identically."""
+        ps = self.page_size
+        digests, h = [], b""
+        for k in range(upto):
+            page = [int(t) for t in tokens[k * ps:(k + 1) * ps]]
+            h = hashlib.sha256(h + repr(page).encode()).digest()
+            digests.append(h.hex())
+        return digests
+
+    def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached page chain covering a PROPER prefix of
+        ``tokens`` (at most ``len(tokens) - 1`` — the final prompt token
+        is always re-run so the request has first-output logits).
+        Returns ``(page_ids, tokens_covered)`` WITHOUT acquiring them —
+        :meth:`admit` does the refcounting."""
+        if not self.prefix_cache_enabled:
+            return [], 0
+        k_max = max(len(tokens) - 1, 0) // self.page_size
+        matched: List[int] = []
+        for key in self._page_digests(tokens, k_max):
+            pid = self._cache.get(key)
+            if pid is None:
+                break
+            matched.append(pid)
+        return matched, len(matched) * self.page_size
+
+    def register_prefix(self, tokens: Sequence[int], lease: SlotLease) -> None:
+        """Publish the lease's full-page prompt prefixes into the cache
+        (called once the prompt's K/V are actually resident — after
+        prefill). First writer wins: a concurrent identical prompt that
+        registered first keeps its pages; ours simply stay private."""
+        if not self.prefix_cache_enabled:
+            return
+        k_max = max(len(tokens) - 1, 0) // self.page_size
+        for k, key in enumerate(self._page_digests(tokens, k_max)):
+            if k >= len(lease.pages):
+                break
+            pid = lease.pages[k]
+            cur = self._cache.get(key)
+            if cur is not None:
+                if cur == pid:
+                    self._cache.move_to_end(key)
+                continue
+            if pid in self._page_key:  # already published under its key
+                continue
+            self._cache[key] = pid
+            self._page_key[pid] = key
+
+    # -- lease lifecycle ----------------------------------------------------
+
+    def admit(self, tokens: Sequence[int], gen_budget: int) -> SlotLease:
+        """Reserve the worst-case page budget for ``tokens`` plus
+        ``gen_budget`` generated tokens, reusing a cached prefix when one
+        matches. Raises :class:`OutOfPages` without side effects when the
+        pool cannot cover it."""
+        need_pages = -(-(len(tokens) + max(gen_budget, 1)) // self.page_size)
+        cached, cached_tokens = self.match_prefix(tokens)
+        need_new = need_pages - len(cached)
+        # IDLE cached pages this admission is about to acquire stop being
+        # evictable the moment it refs them — charge them against
+        # available() too, or a prefix-hit admission could over-commit
+        # the pool and a later extend() (contractually infallible) would
+        # fail mid-generation and poison every in-flight request
+        idle_acquired = sum(1 for pid in cached if not self._ref.get(pid))
+        if need_new + idle_acquired > self.available():
+            raise OutOfPages(
+                f"{need_new} pages needed (+{idle_acquired} idle cached "
+                f"acquired), {self.available()} available "
+                f"({self.num_pages - 1} pool)"
+            )
+        if cached:
+            self.prefix_hits += 1
+            for pid in cached:
+                self._ref[pid] = self._ref.get(pid, 0) + 1
+                key = self._page_key.get(pid)
+                if key is not None:
+                    self._cache.move_to_end(key)
+        elif self.prefix_cache_enabled:
+            self.prefix_misses += 1
+        self._reserved_total += need_new
+        return SlotLease(
+            pages=list(cached), cached_pages=len(cached), reserved=need_new
+        )
+
+    def extend(self, lease: SlotLease) -> int:
+        """Draw the lease's next page from its admission-time reservation
+        (the page table grows as the generation crosses page boundaries).
+        Always succeeds for a lease admitted by :meth:`admit`."""
+        if lease.reserved <= 0:
+            raise OutOfPages("lease reservation exhausted — admission bug")
+        if not self._free:
+            self._evict_idle()
+        pid = self._free.popleft()
+        lease.reserved -= 1
+        self._reserved_total -= 1
+        self._ref[pid] = 1
+        lease.pages.append(pid)
+        return pid
+
+    def release(self, lease: SlotLease) -> None:
+        """Retire a lease: drop every page reference and return the
+        unused reservation. Unreferenced pages return to the free list
+        unless the prefix cache holds them (those stay resident, LRU-
+        evictable, so the next same-prefix request hits)."""
+        self._reserved_total -= lease.reserved
+        lease.reserved = 0
+        for pid in lease.pages:
+            n = self._ref.get(pid, 0) - 1
+            if n > 0:
+                self._ref[pid] = n
+                continue
+            self._ref.pop(pid, None)
+            if pid not in self._page_key:
+                self._free.append(pid)
+        lease.pages = []
+        lease.cached_pages = 0
+
+    def _evict_idle(self) -> None:
+        """Reclaim the LRU idle cached page into the free list. Called
+        only when the free list is dry but ``available()`` promised
+        capacity, so an idle page must exist."""
+        for key, pid in self._cache.items():
+            if not self._ref.get(pid):
+                del self._cache[key]
+                del self._page_key[pid]
+                self._free.append(pid)
+                return
+        raise OutOfPages("no idle cached page to evict — accounting bug")
+
+
+__all__ = ["OutOfPages", "PageAllocator", "SlotLease", "TRASH_PAGE"]

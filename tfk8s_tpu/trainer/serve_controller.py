@@ -119,6 +119,9 @@ def render_serve_pod(serve: TPUServe, version: str, index: int) -> Pod:
         "TFK8S_SERVE_MAX_BATCH": str(spec.batching.max_batch_size),
         "TFK8S_SERVE_BATCH_TIMEOUT_MS": str(spec.batching.batch_timeout_ms),
         "TFK8S_SERVE_QUEUE_LIMIT": str(spec.batching.queue_limit),
+        # decode-loop knobs (generative tasks): paged KV-cache geometry
+        "TFK8S_SERVE_PAGE_SIZE": str(spec.batching.page_size),
+        "TFK8S_SERVE_MAX_PAGES": str(spec.batching.max_pages),
     }
     lbls = L.serve_version_labels(serve.metadata.name, version)
     lbls[L.REPLICA_INDEX] = str(index)
