@@ -1,0 +1,55 @@
+"""Every blocking-under-lock category in one file: sleep, file IO,
+unbounded join, foreign condition wait, jit dispatch, and a depth-1
+call into a helper that does file IO. The timeout'd wait on the lock's
+OWN condition at the end is the legal pattern and must NOT be flagged."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._other_cond = threading.Condition()
+        self._thread = threading.Thread(target=time.sleep)
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def bad_file_io(self, path):
+        with self._lock:
+            with open(path) as f:
+                return f.read()
+
+    def bad_join(self):
+        with self._lock:
+            self._thread.join()
+
+    def bad_foreign_wait(self):
+        with self._lock:
+            self._other_cond.wait()
+
+    def bad_jit(self, a, b):
+        with self._lock:
+            return jnp.dot(a, b)
+
+    def _flush(self, path):
+        with open(path, "w") as f:
+            f.write("x")
+
+    def bad_indirect(self, path):
+        with self._lock:
+            self._flush(path)
+
+    def ok_own_cond_wait(self):
+        # waiting on the lock's own condition releases it: legal
+        with self._cond:
+            self._cond.wait(timeout=1.0)
+
+    def ok_bounded_join(self):
+        with self._lock:
+            self._thread.join(1.0)

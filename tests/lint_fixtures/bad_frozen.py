@@ -1,0 +1,43 @@
+"""Writes to objects from frozen read paths without thaw()/deepcopy.
+The thaw'd and deepcopy'd paths at the bottom are legal and must NOT be
+flagged."""
+
+import copy
+
+from tfk8s_tpu.api.frozen import thaw
+
+
+class Controller:
+    def __init__(self, store, lister):
+        self.store = store
+        self.lister = lister
+
+    def bad_attr_write(self, ns, name):
+        job = self.store.get("Job", ns, name)
+        job.status = "Hacked"
+        return job
+
+    def bad_list_iteration(self, ns):
+        items, rv = self.store.list("Job", ns)
+        for job in items:
+            job.labels["touched"] = "yes"
+        return rv
+
+    def bad_event_mutation(self, ev):
+        obj = ev.object
+        obj.metadata.labels.update({"seen": "1"})
+
+    def bad_mutator_call(self, ns, name):
+        pod = self.lister.get(ns, name)
+        pod.finalizers.append("me")
+
+    def ok_thawed(self, ns, name):
+        job = thaw(self.store.get("Job", ns, name))
+        job.status = "Fine"
+        return job
+
+    def ok_deepcopy(self, ns):
+        items, _rv = self.store.list("Job", ns)
+        for job in items:
+            mine = copy.deepcopy(job)
+            mine.labels["touched"] = "yes"

@@ -1,0 +1,33 @@
+"""The documented kind→commit order, INVERTED: ``create`` follows the
+real store's order (kind lock, then the commit lock via ``_commit``),
+while ``watch_broken`` takes the commit lock first and the kind lock
+inside it. Together they form the cycle the lock-order checker must
+fail on — this fixture is the acceptance proof that inverting the
+pinned order is caught."""
+
+import threading
+
+
+class ClusterStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._kind_locks = {}
+
+    def _kind_lock(self, kind):
+        with self._lock:
+            return self._kind_locks.setdefault(kind, threading.RLock())
+
+    def _commit(self, txn):
+        with self._lock:
+            return txn
+
+    def create(self, kind, obj):
+        # the correct documented order: kind -> commit
+        with self._kind_lock(kind):
+            return self._commit(obj)
+
+    def watch_broken(self, kind):
+        # the inversion: commit -> kind
+        with self._lock:
+            with self._kind_lock(kind):
+                return list(self._kind_locks)

@@ -1,0 +1,20 @@
+"""Two methods acquiring the same two locks in opposite orders — the
+classic AB/BA deadlock the lock-order checker must report as a cycle."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._pool_lock = threading.Lock()
+        self._route_lock = threading.Lock()
+
+    def assign(self):
+        with self._pool_lock:
+            with self._route_lock:
+                return 1
+
+    def evict(self):
+        with self._route_lock:
+            with self._pool_lock:
+                return 2

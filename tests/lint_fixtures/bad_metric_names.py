@@ -1,0 +1,9 @@
+"""Metric registrations violating the /metrics naming rules. The last
+one is valid and must NOT be flagged."""
+
+
+def record(metrics, dt):
+    metrics.inc("requests")  # counter without _total
+    metrics.observe("request_latency_ms", dt)  # histogram without unit suffix
+    metrics.set_gauge("Queue-Depth", 0.0)  # not snake_case once sanitized
+    metrics.inc("tfk8s_requests_total")  # valid

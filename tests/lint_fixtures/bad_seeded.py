@@ -1,0 +1,30 @@
+"""Wall-clock and module-state RNG in a (pretend) seeded path. The
+seeded constructions at the bottom are legal and must NOT be flagged."""
+
+import random
+import time
+
+import numpy as np
+
+
+def bad_wall_clock():
+    return time.time()
+
+
+def bad_module_rng():
+    return random.random()
+
+
+def bad_np_module_rng():
+    return np.random.rand(3)
+
+
+def bad_unseeded_generator():
+    return np.random.default_rng().integers(10)
+
+
+def ok_seeded(seed, epoch):
+    rng = random.Random(seed)
+    order = np.arange(10)
+    np.random.default_rng(np.random.SeedSequence([seed, epoch])).shuffle(order)
+    return rng.random(), order

@@ -1,0 +1,21 @@
+"""Untyped raises on a (pretend) wire path. The typed raise and the
+re-raise at the bottom are legal and must NOT be flagged."""
+
+
+class ServeError(Exception):
+    pass
+
+
+class Overloaded(ServeError):
+    pass
+
+
+def handle(req):
+    if req is None:
+        raise RuntimeError("no request")  # untyped: flagged
+    if req == "full":
+        raise Overloaded("queue full")  # typed: fine
+    try:
+        return req.run()
+    except Exception as e:
+        raise  # bare re-raise: fine

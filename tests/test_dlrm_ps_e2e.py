@@ -47,6 +47,7 @@ def cluster():
 
 
 
+@pytest.mark.slow
 def test_ps_worker_dlrm_job_trains_with_sharded_embeddings(cluster):
     cs, _ctrl, _stop = cluster
     name = "dlrm-ps"

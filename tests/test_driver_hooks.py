@@ -8,6 +8,7 @@ for hook-shaped reasons."""
 
 import json
 import os
+import pytest
 import subprocess
 import sys
 
@@ -25,6 +26,7 @@ def test_entry_traces_abstractly():
     assert out.shape == (8, 1000)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_runs_on_virtual_mesh():
     """conftest already provisions the 8-device CPU pool, matching the
     driver's xla_force_host_platform_device_count environment."""

@@ -281,6 +281,7 @@ def test_reclaim_notice_resizes_gang_without_burning_backoff(cluster, tmp_path):
     assert any(k.startswith("tfk8s_drain_checkpoint_seconds") for k in hists)
 
 
+@pytest.mark.slow
 def test_dropped_notice_converges_via_legacy_restart(cluster, tmp_path):
     """A host dying with NO notice is still the legacy failure model:
     whole-gang restart-from-checkpoint, one unit of backoff burned —
@@ -337,6 +338,7 @@ def test_dropped_notice_converges_via_legacy_restart(cluster, tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_capacity_return_scales_back_up_debounced(cluster, tmp_path):
     """After a resize down, the controller restores the spec-desired
     count — but only once ``resize_debounce_s`` has elapsed, and the

@@ -214,6 +214,7 @@ def test_evaluator_failure_does_not_kill_the_gang(tmp_path):
         ctrl.controller.shutdown()
 
 
+@pytest.mark.slow
 def test_run_eval_from_record_shards(tmp_path):
     """TFK8S_EVAL_INPUT_FILES: the evaluator reads its held-out set from
     record shards (deterministic unshuffled order — every checkpoint is

@@ -93,6 +93,7 @@ def test_gpt_job_trains_from_record_shards(cluster, tmp_path):
     ), cs.tpujobs("default").get(name).status
 
 
+@pytest.mark.slow
 def test_gpt_job_fails_on_missing_input_files(cluster, tmp_path):
     """A files job pointing at a pattern matching nothing must FAIL (the
     control plane learns input misconfig through the pod, not silently

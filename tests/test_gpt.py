@@ -5,6 +5,7 @@ entrypoint contract. Runs on the 8-virtual-device CPU mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tfk8s_tpu.models import gpt
 from tfk8s_tpu.parallel.mesh import make_mesh
@@ -108,6 +109,7 @@ def test_moe_gpt_trains():
     assert "moe_aux" in history[-1]
 
 
+@pytest.mark.slow
 def test_trains_on_dp_tp_mesh():
     mesh = make_mesh(data=4, tensor=2)
     task = gpt.task_for_mesh(mesh, cfg=gpt.tiny_config(), seq_len=16, batch_size=8)
@@ -116,6 +118,7 @@ def test_trains_on_dp_tp_mesh():
     assert np.isfinite(history[-1]["loss"])
 
 
+@pytest.mark.slow
 def test_sequence_parallel_training_runs():
     mesh = make_mesh(data=2, sequence=4)
     task = gpt.task_for_mesh(
@@ -144,8 +147,6 @@ def test_flash_pin_matches_full():
         np.asarray(l_full), np.asarray(l_flash), atol=1e-3
     )
 
-
-import pytest
 
 
 @pytest.mark.parametrize("cache_len", [None, 12])
@@ -184,6 +185,7 @@ def test_kv_cache_decode_matches_full_forward(cache_len):
         )
 
 
+@pytest.mark.slow
 def test_greedy_generate_continues_the_chain():
     """Train the tiny LM on the affine chain, then greedy-decode a
     continuation from a prompt: predictions must follow the chain's
@@ -320,6 +322,7 @@ def test_sampled_generate_deterministic_per_key_and_varies_across_keys():
     assert len(np.unique(a)) > 4
 
 
+@pytest.mark.slow
 def test_sampled_generate_respects_chain_at_low_temperature():
     """On the trained chain model, low-temperature nucleus sampling stays
     on the deterministic transition (the distribution is near-one-hot)."""
@@ -403,6 +406,7 @@ def test_entrypoint_env_contract():
     gpt.train(env)  # raises on failure; no targets set -> completion is the check
 
 
+@pytest.mark.slow
 def test_hf_gpt2_import_matches_torch_logits():
     """The HF GPT-2 importer (gpt.load_hf_gpt2) produces a model whose
     fp32 logits match the torch reference on the same ids — a randomly

@@ -97,6 +97,7 @@ def test_resnet_job_trains_from_image_shards(cluster, image_shards):
     ), [t.name for t in threading.enumerate()]
 
 
+@pytest.mark.slow
 def test_vit_trains_from_the_same_image_shards(image_shards):
     """The ViT leg: identical batch schema, so the SAME shards feed it
     through the shared files-input mode — configuration, not code."""

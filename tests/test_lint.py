@@ -1,0 +1,261 @@
+"""tools/lint — the tier-1 wiring (clean repo, in-process) and the
+golden known-bad fixtures each checker must flag.
+
+The fixture tests call ``checker.check`` on modules parsed from
+``tests/lint_fixtures/`` directly (bypassing the repo-scope
+``relevant`` filter, which exists precisely to keep those files OUT of
+the clean-tree run).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tools.lint.base import Suppression
+from tools.lint.checkers import all_checkers
+from tools.lint.checkers.blocking_under_lock import BlockingUnderLockChecker
+from tools.lint.checkers.frozen_mutation import FrozenMutationChecker
+from tools.lint.checkers.lock_order import LockOrderChecker
+from tools.lint.checkers.metric_names import MetricNamesChecker
+from tools.lint.checkers.seeded_determinism import SeededDeterminismChecker
+from tools.lint.checkers.typed_errors import TypedErrorsChecker
+from tools.lint.driver import load_modules, load_suppressions, run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def fixture_modules(name: str):
+    modules, errors = load_modules([os.path.join(FIXTURES, name)])
+    assert not errors, errors
+    return modules
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+
+def test_repo_lints_clean_with_all_six_checkers():
+    """THE gate: zero unsuppressed findings, zero format errors, zero
+    unused suppressions, with every checker enabled, in-process."""
+    assert len(all_checkers()) == 6
+    result = run_lint()
+    detail = "\n".join(f.render() for f in result.findings)
+    assert result.ok, f"lint findings on the tree:\n{detail}\n{result.errors}"
+    assert result.clean, (
+        "unused suppressions: "
+        + ", ".join(s.pattern for s in result.unused_suppressions)
+    )
+
+
+def test_every_suppression_carries_a_reason():
+    sups, errors = load_suppressions()
+    assert not errors
+    assert sups, "suppressions file should not be empty in this tree"
+    assert all(s.reason for s in sups)
+
+
+# -- lock-order --------------------------------------------------------------
+
+
+def test_lock_order_flags_ab_ba_cycle():
+    findings = list(LockOrderChecker().check(fixture_modules("bad_lock_order.py")))
+    assert any(f.detail.startswith("cycle:") for f in findings), findings
+    cycle = next(f for f in findings if f.detail.startswith("cycle:"))
+    assert "Worker._pool_lock" in cycle.detail
+    assert "Worker._route_lock" in cycle.detail
+
+
+def test_lock_order_fails_on_inverted_kind_commit_order():
+    """The acceptance fixture: create() follows kind->commit through a
+    _commit call (interprocedural), watch_broken() inverts it — the
+    checker must report the cycle."""
+    mods = fixture_modules("bad_lock_inversion.py")
+    pinned = [(
+        "lint_fixtures.bad_lock_inversion.ClusterStore._kind_lock()",
+        "lint_fixtures.bad_lock_inversion.ClusterStore._lock",
+    )]
+    findings = list(LockOrderChecker(pinned=pinned).check(mods))
+    cycles = [f for f in findings if f.detail.startswith("cycle:")]
+    assert cycles, f"inversion not caught: {findings}"
+    assert "_kind_lock()" in cycles[0].detail and "._lock" in cycles[0].detail
+    # the pinned (documented) edge IS observed via create() -> _commit,
+    # so there must be no unobserved-pin finding — the failure is the
+    # cycle, i.e. the inversion itself
+    assert not any(f.detail.startswith("unobserved:") for f in findings)
+
+
+def test_lock_order_reports_rotted_pin():
+    mods = fixture_modules("bad_lock_order.py")
+    pinned = [("nowhere.Class._a", "nowhere.Class._b")]
+    findings = list(LockOrderChecker(pinned=pinned).check(mods))
+    assert any(f.detail.startswith("unobserved:") for f in findings)
+
+
+def test_lock_order_clean_on_repo_tree():
+    """The real tree's graph is acyclic and the documented kind->commit
+    pin is observed (this is the machine-checked form of the store
+    docstring's ordering rule)."""
+    paths = [os.path.join(REPO, "tfk8s_tpu")]
+    modules, _ = load_modules(paths)
+    assert list(LockOrderChecker().check(modules)) == []
+
+
+# -- blocking-under-lock -----------------------------------------------------
+
+
+def test_blocking_under_lock_catches_every_category():
+    findings = list(
+        BlockingUnderLockChecker().check(fixture_modules("bad_blocking.py"))
+    )
+    details = {f.detail for f in findings}
+    quals = {f.qualname for f in findings}
+    assert "sleep:time.sleep" in details
+    assert "file-io:open" in details
+    assert "join:self._thread.join" in details
+    assert "cond-wait:self._other_cond.wait" in details
+    assert "jit-dispatch:jnp.dot" in details
+    assert "call:self._flush" in details  # depth-1 propagation
+    # the legal patterns stay quiet
+    assert "Cache.ok_own_cond_wait" not in quals
+    assert "Cache.ok_bounded_join" not in quals
+
+
+# -- frozen-mutation ---------------------------------------------------------
+
+
+def test_frozen_mutation_flags_writes_and_respects_thaw():
+    findings = list(
+        FrozenMutationChecker().check(fixture_modules("bad_frozen.py"))
+    )
+    quals = {f.qualname for f in findings}
+    assert "Controller.bad_attr_write" in quals
+    assert "Controller.bad_list_iteration" in quals
+    assert "Controller.bad_event_mutation" in quals
+    assert "Controller.bad_mutator_call" in quals
+    assert "Controller.ok_thawed" not in quals
+    assert "Controller.ok_deepcopy" not in quals
+
+
+# -- typed-errors ------------------------------------------------------------
+
+
+def test_typed_errors_flags_untyped_allows_taxonomy_and_reraise():
+    scope = ("tests/lint_fixtures/bad_typed_errors.py",)
+    findings = list(
+        TypedErrorsChecker(scope=scope).check(
+            fixture_modules("bad_typed_errors.py")
+        )
+    )
+    assert [f.detail for f in findings] == ["raise:RuntimeError"]
+
+
+def test_typed_errors_resolves_error_factories():
+    """raise _map_error(...) in remote.py is allowed because every
+    return of the factory constructs a StoreError subclass."""
+    modules, _ = load_modules([os.path.join(REPO, "tfk8s_tpu")])
+    findings = list(TypedErrorsChecker().check(modules))
+    assert not any(f.detail == "raise:_map_error" for f in findings)
+
+
+# -- seeded-determinism ------------------------------------------------------
+
+
+def test_seeded_determinism_fixture():
+    checker = SeededDeterminismChecker(scope_prefixes=("tests/lint_fixtures/",))
+    findings = list(checker.check(fixture_modules("bad_seeded.py")))
+    details = {f.detail for f in findings}
+    assert "call:time.time" in details
+    assert "call:random.random" in details
+    assert "call:np.random.rand" in details
+    assert "call:np.random.default_rng" in details  # ARGLESS constructor
+    assert not any(f.qualname == "ok_seeded" for f in findings)
+
+
+# -- metric-names ------------------------------------------------------------
+
+
+def test_metric_names_checker_matches_legacy_rules():
+    findings = list(
+        MetricNamesChecker().check(fixture_modules("bad_metric_names.py"))
+    )
+    details = {f.detail for f in findings}
+    assert details == {
+        "inc:requests",
+        "observe:request_latency_ms",
+        "set_gauge:Queue-Depth",
+    }
+
+
+def test_metric_names_checker_scope_covers_legacy_scope():
+    """The folded-in checker must see at least everything the standalone
+    tool saw (tfk8s_tpu, tools, bench.py), minus the linter itself."""
+    c = MetricNamesChecker()
+    assert c.relevant("tfk8s_tpu/runtime/server.py")
+    assert c.relevant("tools/bench_serve.py")
+    assert c.relevant("bench.py")
+    assert not c.relevant("tools/check_metric_names.py")
+    assert not c.relevant("tests/test_metric_names.py")
+
+
+# -- suppression machinery ---------------------------------------------------
+
+
+def test_suppression_matching_is_per_key_glob():
+    s = Suppression(
+        pattern="blocking-under-lock:tfk8s_tpu/client/store.py:_Segment.*:file-io:*",
+        reason="io mutex", lineno=1,
+    )
+    assert s.matches(
+        "blocking-under-lock:tfk8s_tpu/client/store.py:_Segment.append:file-io:open"
+    )
+    assert not s.matches(
+        "blocking-under-lock:tfk8s_tpu/client/store.py:ClusterStore._commit:file-io:open"
+    )
+
+
+def test_reasonless_suppression_is_a_lint_error(tmp_path):
+    p = tmp_path / "sups.txt"
+    p.write_text("typed-errors:a.py:f:raise:X\n")
+    sups, errors = load_suppressions(str(p))
+    assert not sups and len(errors) == 1 and "reason" in errors[0]
+
+
+def test_unused_suppression_blocks_clean(tmp_path):
+    p = tmp_path / "sups.txt"
+    real = open(
+        os.path.join(REPO, "tools", "lint", "suppressions.txt"),
+        encoding="utf-8",
+    ).read()
+    p.write_text(real + "typed-errors:ghost.py:f:raise:X  # stale\n")
+    result = run_lint(suppressions_path=str(p))
+    assert result.ok
+    assert not result.clean
+    assert any("ghost.py" in s.pattern for s in result.unused_suppressions)
+
+
+def test_findings_are_deterministically_ordered():
+    mods = fixture_modules("bad_seeded.py")
+    checker = SeededDeterminismChecker(scope_prefixes=("tests/lint_fixtures/",))
+    a = [f.key for f in checker.check(mods)]
+    b = [f.key for f in checker.check(mods)]
+    assert a == b
+
+
+# -- regression: the typed DeadlineExceeded fix ------------------------------
+
+
+def test_deadline_exceeded_is_typed_and_timeout_compatible():
+    """PR fix driven by the typed-errors checker: serve submit paths now
+    raise DeadlineExceeded (ServeError) instead of a bare TimeoutError,
+    while pre-existing `except TimeoutError` callers keep working."""
+    from tfk8s_tpu.runtime.server import DeadlineExceeded, ServeError
+
+    err = DeadlineExceeded("late")
+    assert isinstance(err, ServeError)
+    assert isinstance(err, TimeoutError)
+    with pytest.raises(TimeoutError):
+        raise DeadlineExceeded("late")
+    with pytest.raises(ServeError):
+        raise DeadlineExceeded("late")

@@ -34,6 +34,7 @@ class TestResNet:
         history = _fit(self._task(), make_mesh(data=8), steps=30, lr=3e-3)
         assert history[-1]["loss"] < history[0]["loss"]
 
+    @pytest.mark.slow
     def test_fsdp_mesh_shards_conv_kernels(self):
         from tfk8s_tpu.models import resnet
         from tfk8s_tpu.parallel import sharding as shd
@@ -58,6 +59,7 @@ class TestResNet:
         )
         assert np.isfinite(float(metrics["loss"]))
 
+    @pytest.mark.slow
     def test_resnet50_shape(self):
         # full-depth graph builds (tiny spatial size to keep CPU time low)
         from tfk8s_tpu.models.resnet import ResNet
@@ -138,10 +140,12 @@ class TestT5:
         kw.setdefault("batch_size", 16)
         return t5.make_task(cfg=cfg, **kw)
 
+    @pytest.mark.slow
     def test_seq2seq_loss_falls(self):
         history = _fit(self._task(), make_mesh(data=8), steps=40, lr=3e-3)
         assert history[-1]["loss"] < history[0]["loss"]
 
+    @pytest.mark.slow
     def test_spmd_tensor_sharding_runs(self):
         mesh = make_mesh(data=2, tensor=4)
         task = self._task()
@@ -274,6 +278,7 @@ def test_t5_incremental_decode_matches_teacher_forced():
         )
 
 
+@pytest.mark.slow
 def test_t5_greedy_generate_solves_reversal():
     """Train the tiny seq2seq on the reversal task, then greedy-decode
     from source only: the generated target must be the reversed source
@@ -303,6 +308,7 @@ def test_t5_greedy_generate_solves_reversal():
     assert acc > 0.7, f"reversal decode accuracy {acc}\n{np.asarray(gen)}\nvs\n{want}"
 
 
+@pytest.mark.slow
 def test_t5_sampled_and_beam_decode():
     """Serving parity across families (VERDICT r4 missing #5): the T5
     sampled path (temperature/top-k/top-p via the SHARED gpt.filter_logits)
@@ -380,6 +386,7 @@ def test_t5_sampled_and_beam_decode():
     assert out.shape == (2, 6)
 
 
+@pytest.mark.slow
 def test_vit_converges_and_shares_the_stack():
     """ViT (models/vit.py): the vision family built from the SAME
     EncoderLayer stack as the text families — converges on the template
@@ -425,6 +432,7 @@ def test_vit_on_sequence_mesh_patches_shard():
     assert np.isfinite(hist[-1]["loss"])
 
 
+@pytest.mark.slow
 def test_vit_moe_trains_with_aux_loss():
     """MoE ViT: the expert layers really get their load-balance pressure —
     aux loss collected (reported as moe_aux) and the model still learns."""

@@ -8,6 +8,8 @@ unit layer is tests/test_multislice.py."""
 import json
 import threading
 
+import pytest
+
 from tfk8s_tpu.api import helpers
 from tfk8s_tpu.api.types import (
     ContainerSpec,
@@ -63,6 +65,7 @@ def test_multislice_job_spec_validates():
     assert any("mesh" in e for e in validate(bad))
 
 
+@pytest.mark.slow
 def test_multislice_job_runs_to_succeeded():
     cs = FakeClientset()
     ctrl = TPUJobController(cs, allocator=SliceAllocator({"cpu-2": 4}))

@@ -34,6 +34,7 @@ def _train_losses(task, mesh, steps=25, lr=3e-3):
 
 
 class TestMoeIntoFamilies:
+    @pytest.mark.slow
     def test_bert_moe_loss_decreases_on_expert_mesh(self):
         mesh = make_mesh(data=2, expert=2)
         cfg = bert.tiny_config(num_experts=4, moe_every=2)
@@ -57,6 +58,7 @@ class TestMoeIntoFamilies:
         assert moe_specs, "no MoE parameters found"
         assert any("expert" in str(spec) for spec in moe_specs.values()), moe_specs
 
+    @pytest.mark.slow
     def test_t5_moe_trains(self):
         mesh = make_mesh(expert=2)
         cfg = t5.tiny_config(num_experts=2, moe_every=2)

@@ -269,6 +269,7 @@ def test_trainer_files_input_mode(tmp_path):
     assert history[-1]["next_token_accuracy"] > 0.4, history[-1]
 
 
+@pytest.mark.slow
 def test_trainer_files_resume_matches_uninterrupted(tmp_path):
     """Checkpoint-resume under files input continues the EXACT record
     stream: the iterator fast-forwards to the restart step, so the
@@ -396,6 +397,7 @@ def test_dataset_record_striping_partitions_any_host_count(tmp_path):
         RecordDataset(files, batch_size=4, shard_by="rows")
 
 
+@pytest.mark.slow
 def test_trainer_files_input_composes_with_grad_accum(tmp_path):
     """files mode + grad_accum_steps: the microbatch reshape happens in
     prepare_batch AFTER the dataset produces the flat local batch, and

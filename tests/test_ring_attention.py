@@ -184,6 +184,7 @@ def test_encoder_with_ring_attention_matches_full():
     )
 
 
+@pytest.mark.slow
 def test_bert_task_for_mesh_wires_ring_attention():
     """The attention_impl knob / sequence axis must actually route BERT
     through ring attention (and training still runs)."""
@@ -291,6 +292,7 @@ def test_padding_mask_gradients_match(causal):
     assert np.all(np.asarray(got[2]) * (1 - kv_valid) == 0)
 
 
+@pytest.mark.slow
 def test_t5_encdec_with_ring_attention_padded_matches_full():
     """The whole point of mask-capable SP: a PADDED enc-dec model on a
     sequence-sharded mesh produces the same logits through the ring
@@ -320,6 +322,7 @@ def test_t5_encdec_with_ring_attention_padded_matches_full():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_fully_padded_row_gradients_finite_and_match(causal):
     """The degenerate case the where-guard exists for: a batch row with
@@ -372,6 +375,7 @@ def test_cross_attention_unequal_lengths_with_mask():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(16, 32), (32, 16)])
 def test_causal_unequal_lengths_end_aligned(shape):
     """Causal masking with lq != lk follows the END-aligned convention of

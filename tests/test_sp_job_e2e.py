@@ -95,6 +95,7 @@ def test_sequence_parallel_bert_job_succeeds(cluster):
     )
 
 
+@pytest.mark.slow
 def test_explicit_ring_impl_job_succeeds(cluster):
     """The TFK8S_ATTENTION_IMPL knob pins ring attention explicitly —
     the beyond-head-count long-context path, job-selectable."""

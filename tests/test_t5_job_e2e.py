@@ -43,6 +43,7 @@ def cluster():
     ctrl.controller.shutdown()
 
 
+@pytest.mark.slow
 def test_t5_tensor_parallel_job_succeeds(cluster):
     cs, _ctrl, _stop = cluster
     name = "t5-tp"

@@ -1,0 +1,144 @@
+"""Tier-1 wall-clock budget guard.
+
+The tier-1 gate (ROADMAP.md) runs ``pytest tests/ -m 'not slow'`` under
+``timeout -k 10 870`` on a 1-core box. The suite outgrew that window
+once (full call time ~2x the budget); the fix was to mark the heaviest
+non-gating end-to-end parametrizations ``slow`` — each one is either a
+redundant family flavor (another fast test gates the same subsystem) or
+a multi-minute characterization run.
+
+This module pins that decision: every entry in ``HEAVY`` measured
+above ``HEAVY_SECONDS`` on the 1-core box must carry the ``slow``
+marker, so an accidental decorator removal (or a rename that silently
+drops the mark) shows up as a fast, legible failure instead of a tier-1
+timeout three PRs later. Conversely ``FAST_GATES`` pins the one
+representative per subsystem that must STAY in tier-1 — marking those
+slow would leave the subsystem ungated.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+BUDGET_SECONDS = 870  # timeout -k 10 870 in the ROADMAP tier-1 command
+HEAVY_SECONDS = 7.5  # measured call-time floor for the pinned list
+
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+# (module file, qualname) -> measured seconds on the 1-core CPU box.
+# Together these cut ~745s of call time out of the ~1395s total.
+HEAVY = [
+    ("test_driver_hooks.py", "test_dryrun_multichip_runs_on_virtual_mesh"),
+    ("test_models.py", "test_t5_greedy_generate_solves_reversal"),
+    ("test_models.py", "TestT5.test_seq2seq_loss_falls"),
+    ("test_models.py", "TestT5.test_spmd_tensor_sharding_runs"),
+    ("test_models.py", "test_t5_sampled_and_beam_decode"),
+    ("test_models.py", "test_vit_converges_and_shares_the_stack"),
+    ("test_models.py", "test_vit_moe_trains_with_aux_loss"),
+    ("test_models.py", "TestResNet.test_resnet50_shape"),
+    ("test_models.py", "TestResNet.test_fsdp_mesh_shards_conv_kernels"),
+    ("test_evaluator.py", "test_run_eval_from_record_shards"),
+    ("test_recordio.py", "test_trainer_files_resume_matches_uninterrupted"),
+    ("test_recordio.py", "test_trainer_files_input_composes_with_grad_accum"),
+    ("test_ulysses.py", "test_bert_task_for_mesh_prefers_ulysses_within_head_count"),
+    ("test_ulysses.py", "test_t5_task_for_mesh_ulysses_trains"),
+    ("test_elastic_e2e.py", "test_capacity_return_scales_back_up_debounced"),
+    ("test_elastic_e2e.py", "test_dropped_notice_converges_via_legacy_restart"),
+    ("test_ring_attention.py", "test_bert_task_for_mesh_wires_ring_attention"),
+    ("test_ring_attention.py", "test_t5_encdec_with_ring_attention_padded_matches_full"),
+    ("test_ring_attention.py", "test_causal_unequal_lengths_end_aligned"),
+    ("test_ring_attention.py", "test_fully_padded_row_gradients_finite_and_match"),
+    ("test_t5_job_e2e.py", "test_t5_tensor_parallel_job_succeeds"),
+    ("test_files_job_e2e.py", "test_gpt_job_fails_on_missing_input_files"),
+    ("test_train_runtime.py", "test_fit_loop_throughput_matches_scanned_steps"),
+    ("test_pp_ep_integration.py", "TestMoeIntoFamilies.test_t5_moe_trains"),
+    ("test_pp_ep_integration.py",
+     "TestMoeIntoFamilies.test_bert_moe_loss_decreases_on_expert_mesh"),
+    ("test_gpt.py", "test_hf_gpt2_import_matches_torch_logits"),
+    ("test_gpt.py", "test_greedy_generate_continues_the_chain"),
+    ("test_gpt.py", "test_sampled_generate_respects_chain_at_low_temperature"),
+    ("test_gpt.py", "test_sequence_parallel_training_runs"),
+    ("test_gpt.py", "test_trains_on_dp_tp_mesh"),
+    ("test_dlrm_ps_e2e.py", "test_ps_worker_dlrm_job_trains_with_sharded_embeddings"),
+    ("test_multislice_e2e.py", "test_multislice_job_runs_to_succeeded"),
+    ("test_sp_job_e2e.py", "test_explicit_ring_impl_job_succeeds"),
+    ("test_image_job_e2e.py", "test_vit_trains_from_the_same_image_shards"),
+]
+
+# The fast representative that keeps each subsystem gated in tier-1.
+FAST_GATES = [
+    ("test_driver_hooks.py", "test_entry_traces_abstractly"),
+    ("test_models.py", "TestResNet.test_loss_falls_data_parallel"),
+    ("test_models.py", "test_t5_incremental_decode_matches_teacher_forced"),
+    ("test_evaluator.py", "test_worker_plus_evaluator_job_e2e"),
+    ("test_recordio.py", "test_trainer_files_input_mode"),
+    ("test_gpt.py", "test_ulysses_matches_full_on_same_params"),
+    ("test_elastic_e2e.py", "test_reclaim_notice_resizes_gang_without_burning_backoff"),
+    ("test_ring_attention.py", "test_gradients_match_full_attention"),
+    ("test_files_job_e2e.py", "test_gpt_job_trains_from_record_shards"),
+    ("test_train_runtime.py", "test_mnist_tpujob_end_to_end"),
+    ("test_pp_ep_integration.py",
+     "TestPipelinedFamily.test_matches_sequential_composition"),
+    ("test_gpt.py", "test_next_token_loss_falls_and_predicts_chain"),
+    ("test_models.py", "TestDLRM.test_ctr_loss_falls"),
+    ("test_multislice.py", "test_multislice_train_step_runs"),
+    ("test_sp_job_e2e.py", "test_sequence_parallel_bert_job_succeeds"),
+    ("test_image_job_e2e.py", "test_resnet_job_trains_from_image_shards"),
+]
+
+
+def _load(modfile: str):
+    name = "tier1_budget_probe_" + modfile[:-3]
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TESTS, modfile)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(modfile: str, qualname: str):
+    obj = _load(modfile)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _marks(fn):
+    return {m.name for m in getattr(fn, "pytestmark", [])}
+
+
+def test_every_pinned_heavy_test_is_marked_slow():
+    missing = []
+    for modfile, qualname in HEAVY:
+        fn = _resolve(modfile, qualname)
+        if "slow" not in _marks(fn):
+            missing.append(f"{modfile}::{qualname}")
+    assert not missing, (
+        f"heavy tests (> {HEAVY_SECONDS}s each) lost their slow marker —"
+        f" tier-1 will blow the {BUDGET_SECONDS}s window: {missing}"
+    )
+
+
+def test_fast_gates_stay_in_tier1():
+    marked = []
+    for modfile, qualname in FAST_GATES:
+        fn = _resolve(modfile, qualname)
+        if "slow" in _marks(fn):
+            marked.append(f"{modfile}::{qualname}")
+    assert not marked, (
+        "subsystem gates were marked slow — tier-1 no longer exercises"
+        f" their subsystem at all: {marked}"
+    )
+
+
+def test_pinned_lists_are_disjoint_and_well_formed():
+    heavy, gates = set(HEAVY), set(FAST_GATES)
+    assert len(HEAVY) == len(heavy)
+    assert len(FAST_GATES) == len(gates)
+    assert not heavy & gates

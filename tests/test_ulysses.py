@@ -87,6 +87,7 @@ def test_full_qk_mask_rejected():
         uly(q, k, v, mask=jnp.ones((2, 32, 32), bool))
 
 
+@pytest.mark.slow
 def test_bert_task_for_mesh_prefers_ulysses_within_head_count():
     """Auto-selection on a sequence-sharded mesh: Ulysses while the
     sequence degree divides the per-device head count, ring beyond."""
@@ -193,6 +194,7 @@ def test_ulysses_composes_with_flash_kernel():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_t5_task_for_mesh_ulysses_trains():
     """T5 long-context now has an SP path (Ulysses carries the decoder's
     key-padding masks; ring could not)."""

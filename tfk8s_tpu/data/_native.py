@@ -29,6 +29,35 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+# TFK8S_NATIVE_SANITIZE=asan|ubsan builds the native cores with the
+# matching sanitizer (separate cache key, so sanitized and plain .so
+# files coexist). -O1 overrides the base -O3 for usable stack traces.
+# NOTE an asan .so usually cannot be dlopen'd into an un-instrumented
+# python without LD_PRELOAD=libasan.so — load() degrades to the pure
+# fallback in that case (skip, not fail); tools/sanitize_smoke.py is
+# the subprocess driver that sets the preload up properly.
+_SANITIZE_FLAGS = {
+    "asan": ("-fsanitize=address", "-fno-omit-frame-pointer", "-g", "-O1"),
+    "ubsan": ("-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+              "-g", "-O1"),
+}
+
+
+def sanitize_mode() -> Optional[str]:
+    """The validated TFK8S_NATIVE_SANITIZE value, or None. An unknown
+    value warns once and is ignored rather than silently building an
+    unsanitized core under a sanitizer-suggesting name."""
+    mode = os.environ.get("TFK8S_NATIVE_SANITIZE", "").strip().lower()
+    if not mode:
+        return None
+    if mode not in _SANITIZE_FLAGS:
+        log.warning(
+            "TFK8S_NATIVE_SANITIZE=%r is not one of %s; building plain",
+            mode, "/".join(sorted(_SANITIZE_FLAGS)),
+        )
+        return None
+    return mode
+
 
 def _cache_dir() -> str:
     d = os.environ.get("TFK8S_NATIVE_CACHE") or os.path.join(
@@ -57,6 +86,11 @@ def build_cached(
     its degraded path in the warnings."""
     src = open(src_path, "rb").read()
     tag = hashlib.sha256(src).hexdigest()[:16]
+    mode = sanitize_mode()
+    sanitize_flags: tuple = ()
+    if mode is not None:
+        prefix = f"{prefix}-{mode}"
+        sanitize_flags = _SANITIZE_FLAGS[mode]
     out = os.path.join(_cache_dir(), f"{prefix}-{tag}.so")
     if os.path.exists(out):
         return out
@@ -67,7 +101,7 @@ def build_cached(
     os.close(fd)
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", src_path,
-        "-o", tmp, *extra_flags,
+        "-o", tmp, *sanitize_flags, *extra_flags,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -107,6 +141,23 @@ def build_cached(
         return None
 
 
+def dlopen_checked(
+    path: str, build_log: logging.Logger, what: str, fallback: str
+) -> Optional[ctypes.CDLL]:
+    """ctypes.CDLL with the OSError path downgraded to a warning + None
+    (fallback), shared by both native binders. The common way to get
+    here: a sanitized .so whose runtime (libasan) is not preloaded into
+    this process — a configuration to degrade from, not to crash on."""
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        build_log.warning(
+            "native %s built but failed to load (%s); falling back to %s",
+            what, e, fallback,
+        )
+        return None
+
+
 def _build() -> Optional[str]:
     return build_cached(
         _SRC, "recordio", log, "recordio core",
@@ -130,7 +181,13 @@ def load() -> Optional[ctypes.CDLL]:
         if path is None:
             _tried = True
             return None
-        lib = ctypes.CDLL(path)
+        lib = dlopen_checked(
+            path, log, "recordio core",
+            "the pure-Python codec (~120x slower reads)",
+        )
+        if lib is None:
+            _tried = True
+            return None
         i64, u32 = ctypes.c_int64, ctypes.c_uint32
         pi64 = ctypes.POINTER(i64)
         pu8 = ctypes.POINTER(ctypes.c_uint8)

@@ -34,7 +34,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from tfk8s_tpu.data._native import build_cached
+from tfk8s_tpu.data._native import build_cached, dlopen_checked
 
 log = logging.getLogger("tfk8s.data.images.native")
 
@@ -84,7 +84,13 @@ def load() -> Optional[ctypes.CDLL]:
         if path is None:
             _tried = True
             return None
-        lib = ctypes.CDLL(path)
+        lib = dlopen_checked(
+            path, log, "image-decode core",
+            "the PIL decoder (~2-4x slower per decode worker)",
+        )
+        if lib is None:
+            _tried = True
+            return None
         lib.img_info.restype = _i64
         lib.img_info.argtypes = [ctypes.c_char_p, _i64, _pi64, _pi64, _pi64]
         lib.img_decode.restype = _i64

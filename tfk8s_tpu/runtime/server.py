@@ -89,6 +89,13 @@ class RequestFailed(ServeError):
     """The model raised while executing the batch this request rode."""
 
 
+class DeadlineExceeded(ServeError, TimeoutError):
+    """The caller's deadline elapsed before the request was served — the
+    typed 504 equivalent. Subclasses :class:`TimeoutError` so callers
+    written against the original ``raise TimeoutError`` contract keep
+    working, while the serve paths now only raise the ServeError tree."""
+
+
 # ---------------------------------------------------------------------------
 # Served models
 # ---------------------------------------------------------------------------
@@ -627,7 +634,7 @@ class DecodeLoopExecutor:
 
     def submit(self, payload: Any, timeout: Optional[float] = 30.0) -> Any:
         """Blocking request; raises Overloaded / Draining / InvalidRequest
-        / RequestFailed / TimeoutError — the :class:`ModelServer`
+        / RequestFailed / DeadlineExceeded — the :class:`ModelServer`
         contract. Returns ``{"tokens": [...], "version": ...}`` with the
         generated continuation (ending at eos or the budget)."""
         try:
@@ -670,7 +677,7 @@ class DecodeLoopExecutor:
                     )
                 except ValueError:
                     pass  # already admitted into a slot; it will finish
-            raise TimeoutError(f"request not served within {timeout}s")
+            raise DeadlineExceeded(f"request not served within {timeout}s")
         if req.error is not None:
             raise RequestFailed(str(req.error)) from req.error
         return req.result
@@ -1112,7 +1119,7 @@ class ModelServer:
     def submit(self, payload: Any, timeout: Optional[float] = 30.0) -> Any:
         """Blocking request: returns the model's response for ``payload``,
         or raises Overloaded / Draining / InvalidRequest / RequestFailed /
-        TimeoutError."""
+        DeadlineExceeded (a TimeoutError subclass)."""
         try:
             bucket = self.model.bucket_of(payload)  # TypeError: bad payload
         except InvalidRequest:
@@ -1158,7 +1165,7 @@ class ModelServer:
                     )
                 except ValueError:
                     pass  # already dequeued into a batch
-            raise TimeoutError(f"request not served within {timeout}s")
+            raise DeadlineExceeded(f"request not served within {timeout}s")
         if req.error is not None:
             raise RequestFailed(str(req.error)) from req.error
         return req.result
@@ -1453,7 +1460,7 @@ class ServeClient:
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise TimeoutError(
+                raise DeadlineExceeded(
                     f"no replica of {self.namespace}/{self.name} served the "
                     f"request within {timeout}s"
                 )
@@ -1494,6 +1501,7 @@ def template_hash(wire_fragment: Any) -> str:
 
 
 __all__ = [
+    "DeadlineExceeded",
     "DecodeLoopExecutor",
     "Draining",
     "EchoModel",

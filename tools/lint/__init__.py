@@ -1,0 +1,43 @@
+"""tfk8s-lint: the repo-native static analysis suite (ISSUE 9).
+
+One shared AST driver (:mod:`tools.lint.driver`), per-checker modules
+under :mod:`tools.lint.checkers`, one triaged suppressions file
+(``tools/lint/suppressions.txt``), one entry point::
+
+    python -m tools.lint            # lint the default scope, exit 1 on findings
+    python -m tools.lint path/...   # lint specific files/dirs
+
+The six checkers turn the concurrency/purity invariants that previously
+lived in CHANGES.md prose into machine-checked properties:
+
+==================== ======================================================
+checker              invariant
+==================== ======================================================
+lock-order           the whole-repo lock-acquisition graph is acyclic and
+                     the documented kind→commit order holds (pinned edges)
+blocking-under-lock  no sleep / file・socket IO / unbounded join / foreign
+                     condition-wait / jit dispatch inside a held lock
+frozen-mutation      objects from store/informer/lister read paths are
+                     never written without an intervening thaw()/deepcopy
+typed-errors         every raise on apiserver handler, ServeClient submit,
+                     and executor report paths is from the typed taxonomy
+seeded-determinism   no wall-clock/module-state RNG inside the seeded
+                     augmentation/chaos/checkpoint-discovery paths
+metric-names         the /metrics namespace rules (snake_case, _total,
+                     unit suffixes) — folded in from check_metric_names
+==================== ======================================================
+
+Suppressions are keyed ``checker:relpath:qualname:detail`` (fnmatch
+globs allowed per field) and MUST carry a reason — an unexplained
+suppression is itself a lint error, and unused suppressions are reported
+so the file can only shrink as code improves. Wired into tier-1 by
+``tests/test_lint.py`` (in-process, no subprocess-per-checker).
+"""
+
+from tools.lint.base import Checker, Finding, Module, Suppression  # noqa: F401
+from tools.lint.driver import (  # noqa: F401
+    DEFAULT_SUPPRESSIONS,
+    default_paths,
+    load_suppressions,
+    run_lint,
+)

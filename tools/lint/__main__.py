@@ -1,0 +1,63 @@
+"""``python -m tools.lint`` — the single entry point.
+
+Exit status: 0 when clean, 1 on findings / format errors / unused
+suppressions. ``--no-suppress`` shows everything the checkers see
+(useful when triaging); ``--checker NAME`` (repeatable) runs a subset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from tools.lint.checkers import all_checkers
+from tools.lint.driver import run_lint
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="tfk8s-lint: repo-native static analysis",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: repo scope)")
+    ap.add_argument("--no-suppress", action="store_true",
+                    help="ignore suppressions.txt (triage mode)")
+    ap.add_argument("--checker", action="append", default=[],
+                    help="run only this checker (repeatable)")
+    args = ap.parse_args(argv)
+
+    checkers = None
+    if args.checker:
+        by_name = {c.name: c for c in all_checkers()}
+        unknown = [n for n in args.checker if n not in by_name]
+        if unknown:
+            print(f"unknown checker(s): {', '.join(unknown)}; "
+                  f"have: {', '.join(sorted(by_name))}", file=sys.stderr)
+            return 2
+        checkers = [by_name[n] for n in args.checker]
+
+    result = run_lint(
+        paths=args.paths or None,
+        checkers=checkers,
+        suppress=not args.no_suppress,
+    )
+    for err in result.errors:
+        print(f"ERROR: {err}")
+    for finding in result.findings:
+        print(finding.render())
+    for sup in result.unused_suppressions:
+        print(f"suppressions.txt:{sup.lineno}: UNUSED suppression "
+              f"{sup.pattern!r} — delete it")
+    n_checkers = len(checkers) if checkers is not None else len(all_checkers())
+    if result.clean:
+        print(f"lint ok ({n_checkers} checkers, "
+              f"{len(result.suppressed)} suppressed with reason)")
+        return 0
+    print(f"{len(result.findings)} finding(s), {len(result.errors)} error(s), "
+          f"{len(result.unused_suppressions)} unused suppression(s)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

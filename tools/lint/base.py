@@ -1,0 +1,124 @@
+"""Shared checker plumbing: parsed modules, findings, suppressions.
+
+A checker sees :class:`Module` objects (path + parsed AST + source) and
+yields :class:`Finding`\\ s. Findings carry a stable ``key`` —
+``checker:relpath:qualname:detail`` — that survives line-number drift,
+so the suppressions file does not rot every time an unrelated edit moves
+code around. Line numbers are for humans only.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional
+
+
+@dataclass
+class Module:
+    """One parsed source file handed to every checker."""
+
+    path: str  # absolute
+    relpath: str  # repo-relative, '/'-separated (the key form)
+    tree: ast.Module
+    source: str
+
+    @property
+    def dotted(self) -> str:
+        """``tfk8s_tpu/client/store.py`` → ``client.store`` (the lock-name
+        prefix; top-level files keep their stem)."""
+        rel = self.relpath
+        for prefix in ("tfk8s_tpu/", "tools/", "tests/"):
+            if rel.startswith(prefix):
+                rel = rel[len(prefix):]
+                break
+        return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+@dataclass
+class Finding:
+    checker: str
+    relpath: str
+    line: int
+    qualname: str  # enclosing Class.method / function ('' at module level)
+    detail: str  # what was matched (lock pair, callee, exception name...)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.checker}:{self.relpath}:{self.qualname}:{self.detail}"
+
+    def render(self) -> str:
+        return (
+            f"{self.relpath}:{self.line}: [{self.checker}] {self.message}\n"
+            f"    key: {self.key}"
+        )
+
+
+@dataclass
+class Suppression:
+    """One triaged line of ``suppressions.txt``: a key pattern (fnmatch
+    globs allowed in every field) plus the mandatory reason."""
+
+    pattern: str
+    reason: str
+    lineno: int
+    used: bool = field(default=False)
+
+    def matches(self, finding_key: str) -> bool:
+        return fnmatch.fnmatchcase(finding_key, self.pattern)
+
+
+class Checker:
+    """Base class: subclasses set ``name`` and implement :meth:`check`.
+
+    ``relevant`` scopes which files a checker sees — the driver parses
+    the union of all scopes once and fans the modules out.
+    """
+
+    name: str = ""
+
+    def relevant(self, relpath: str) -> bool:
+        return relpath.startswith("tfk8s_tpu/")
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class QualnameVisitor(ast.NodeVisitor):
+    """Visitor that tracks the enclosing ``Class.method`` qualname —
+    the shared scaffolding every AST checker builds on."""
+
+    def __init__(self) -> None:
+        self._stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._stack)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None. Call roots are
+    resolved through to their func (``self.f(x).g`` → ``self.f().g``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Call):
+        base = dotted_name(node.func)
+        return f"{base}()" if base else None
+    return None

@@ -1,0 +1,25 @@
+"""The six checkers. ``all_checkers()`` is the driver's registry —
+order here is the order findings are attributed, so keep it stable."""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.lint.base import Checker
+from tools.lint.checkers.blocking_under_lock import BlockingUnderLockChecker
+from tools.lint.checkers.frozen_mutation import FrozenMutationChecker
+from tools.lint.checkers.lock_order import LockOrderChecker
+from tools.lint.checkers.metric_names import MetricNamesChecker
+from tools.lint.checkers.seeded_determinism import SeededDeterminismChecker
+from tools.lint.checkers.typed_errors import TypedErrorsChecker
+
+
+def all_checkers() -> List[Checker]:
+    return [
+        LockOrderChecker(),
+        BlockingUnderLockChecker(),
+        FrozenMutationChecker(),
+        TypedErrorsChecker(),
+        SeededDeterminismChecker(),
+        MetricNamesChecker(),
+    ]

@@ -1,0 +1,170 @@
+"""blocking-under-lock: nothing that can stall other threads runs
+inside a held lock region.
+
+Flagged while at least one lock is held:
+
+- ``time.sleep`` (and ``time.sleep``-shaped aliases);
+- file / fd IO: ``open(...)``, ``os.fsync`` / ``os.fdatasync`` /
+  ``os.replace`` / ``os.rename``, ``shutil.*``, ``subprocess.*``;
+- socket-ish calls: ``socket.create_connection``, receiver methods
+  ``connect`` / ``accept`` / ``recv`` / ``recv_into`` / ``sendall``,
+  ``urllib.request.urlopen``, ``http.client`` requests;
+- ``<x>.join()`` with no arguments (a thread/process join with no
+  timeout; ``sep.join(parts)`` takes an argument and is never flagged);
+- ``<cond>.wait()`` / ``wait_for`` WITHOUT a timeout when the waiter
+  holds any OTHER lock than the condition's own underlying lock (the
+  standard ``with cond: cond.wait()`` pattern is exempt, including
+  through ``threading.Condition(self._lock)`` aliases);
+- jit dispatch: any ``jax.*`` / ``jnp.*`` call or ``block_until_ready``.
+
+One level of propagation: calling a same-class method / same-module
+function that DIRECTLY contains one of the primitives above is flagged
+at the call site (``self._compact()`` under the commit lock). Deeper
+transitive chains are out of scope by design — depth 1 already covers
+the repo's real layering and deeper propagation turns every helper into
+a false positive cascade.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint.base import Checker, Finding, Module, dotted_name
+from tools.lint.locks import ModuleLocks
+
+_SLEEPS = {"time.sleep"}
+_FILE_IO = {
+    "os.fsync", "os.fdatasync", "os.sync", "os.replace", "os.rename",
+    "os.remove", "os.unlink", "os.makedirs",
+    "shutil.copy", "shutil.copy2", "shutil.copytree", "shutil.move",
+    "shutil.rmtree",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+}
+_NET = {"socket.create_connection", "urllib.request.urlopen"}
+_SOCKET_METHODS = {"connect", "accept", "recv", "recv_into", "sendall",
+                   "makefile", "getresponse"}
+_WAITS = {"wait", "wait_for"}
+_JIT_PREFIXES = ("jax.", "jnp.")
+
+
+def _primitive(call: ast.Call, callee: Optional[str]) -> Optional[str]:
+    """Classify a call as a blocking primitive (lock-context-free part).
+    Returns a short tag or None."""
+    if callee is None:
+        return None
+    if callee in _SLEEPS:
+        return "sleep"
+    if callee == "open" or callee in _FILE_IO:
+        return "file-io"
+    if callee in _NET:
+        return "net-io"
+    if callee.startswith(_JIT_PREFIXES) or callee.endswith(".block_until_ready"):
+        return "jit-dispatch"
+    attr = callee.rsplit(".", 1)[-1]
+    if "." in callee and attr in _SOCKET_METHODS:
+        return "net-io"
+    if attr == "join" and not call.args and not call.keywords:
+        return "join"
+    return None
+
+
+def _wait_without_timeout(call: ast.Call, callee: Optional[str]) -> bool:
+    if callee is None or "." not in callee:
+        return False
+    if callee.rsplit(".", 1)[-1] not in _WAITS:
+        return False
+    has_timeout = bool(call.args) or any(
+        kw.arg in ("timeout", None) for kw in call.keywords
+    )
+    # wait_for(pred) with no timeout arg is still unbounded
+    if callee.endswith("wait_for") and len(call.args) == 1 and not call.keywords:
+        has_timeout = False
+    return not has_timeout
+
+
+class BlockingUnderLockChecker(Checker):
+    name = "blocking-under-lock"
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:
+        mods = [ModuleLocks(m) for m in modules]
+
+        # pass 1: which functions DIRECTLY contain a blocking primitive
+        # (for depth-1 call-site propagation). Condition-waits count
+        # here even when locally exempt: they still block the caller.
+        blocking_fns: Dict[Tuple[str, str], str] = {}
+        for ml in mods:
+            for fn in ml.functions:
+                for call in fn.calls:
+                    tag = _primitive(call.node, call.callee)
+                    if tag is None and _wait_without_timeout(call.node, call.callee):
+                        tag = "cond-wait"
+                    if tag is not None:
+                        blocking_fns.setdefault(
+                            (ml.module.dotted, fn.qualname), tag
+                        )
+                        break
+
+        # pass 2: calls made while holding a lock
+        for ml in mods:
+            rel = ml.module.relpath
+            for fn in ml.functions:
+                for call in fn.calls:
+                    if not call.held:
+                        continue
+                    tag = _primitive(call.node, call.callee)
+                    if tag is not None:
+                        yield self._finding(rel, fn.qualname, call.line,
+                                            f"{tag}:{call.callee}", call.held)
+                        continue
+                    if _wait_without_timeout(call.node, call.callee):
+                        # exempt: waiting on (an alias of) a lock we hold,
+                        # and it is the ONLY lock held
+                        recv = call.node.func.value  # type: ignore[union-attr]
+                        recv_id = ml.lock_id(recv, fn.cls)
+                        others = [h for h in call.held if h != recv_id]
+                        if others:
+                            yield self._finding(
+                                rel, fn.qualname, call.line,
+                                f"cond-wait:{call.callee}", tuple(others))
+                        continue
+                    # depth-1 propagation through local calls
+                    target = self._local_target(ml, fn, call.callee)
+                    if target is not None and target in blocking_fns:
+                        yield self._finding(
+                            rel, fn.qualname, call.line,
+                            f"call:{call.callee}", call.held,
+                            because=blocking_fns[target])
+
+    @staticmethod
+    def _local_target(ml: ModuleLocks, fn, callee: Optional[str]):
+        if callee is None:
+            return None
+        if callee.startswith("self.") and fn.cls:
+            meth = callee[len("self."):]
+            if "." not in meth:
+                return (ml.module.dotted, f"{fn.cls}.{meth}")
+            return None
+        if "." not in callee:
+            return (ml.module.dotted, callee)
+        return None
+
+    def _finding(self, rel: str, qual: str, line: int, detail: str,
+                 held: Tuple[str, ...], because: Optional[str] = None) -> Finding:
+        what = detail.split(":", 1)[0]
+        msg = {
+            "sleep": "sleep while holding",
+            "file-io": "file IO while holding",
+            "net-io": "socket/network IO while holding",
+            "jit-dispatch": "jit dispatch while holding",
+            "join": "unbounded join() while holding",
+            "cond-wait": "condition wait without timeout while holding",
+            "call": "call into blocking code while holding",
+        }[what]
+        suffix = f" (callee directly does {because})" if because else ""
+        return Finding(
+            checker=self.name, relpath=rel, line=line, qualname=qual,
+            detail=detail,
+            message=f"{detail.split(':', 1)[1]}: {msg} {', '.join(held)}{suffix}",
+        )

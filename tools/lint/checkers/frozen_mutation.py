@@ -1,0 +1,199 @@
+"""frozen-mutation: objects read from the store / informer / lister
+paths are shared frozen instances — writing to one without an
+intervening ``thaw()`` / ``deepcopy`` is a bug that (best case) raises
+``FrozenObjectError`` at runtime and (worst case, plain dicts) corrupts
+every other reader.
+
+Taint sources (intra-function, linear order):
+
+- ``x = <recv>.get(...)`` / ``.get_by_key(...)`` / ``.list(...)`` where
+  the receiver's dotted name contains a store/lister/indexer/informer
+  word (``self.store``, ``self._indexer``, ``job_lister``...);
+- ``items, rv = store.list(...)`` tuple unpacking taints each target;
+- ``x = ev.object`` (watch event payloads are frozen too);
+- iterating or subscripting a tainted collection taints the loop/element
+  variable.
+
+Cleared by rebinding: ``x = thaw(x)``, ``x = copy.deepcopy(x)``,
+``x = dataclasses.replace(...)``, or any other assignment to the name.
+Flags: attribute/subscript writes rooted at a tainted name, augmented
+assigns, and in-place mutator method calls (``append``/``update``/
+``pop``/``sort``/...). Store WRITE verbs (create/update/patch) return
+private copies, so their results are deliberately not tainted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.lint.base import Checker, Finding, Module, dotted_name
+
+_READ_VERBS = {"get", "get_by_key", "list"}
+_SOURCE_WORDS = ("store", "lister", "indexer", "informer", "cache")
+_CLEARERS = ("thaw", "deepcopy", "replace", "roundtrip", "to_dict", "from_dict")
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popitem", "remove", "clear",
+    "sort", "reverse", "update", "setdefault", "add", "discard",
+}
+_EVENT_NAMES = {"ev", "event", "evt"}
+
+
+def _is_source_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    callee = dotted_name(node.func)
+    if callee is None or "." not in callee:
+        return False
+    base, _, verb = callee.rpartition(".")
+    if verb not in _READ_VERBS:
+        return False
+    return any(w in base.lower() for w in _SOURCE_WORDS)
+
+
+def _is_event_object(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "object"
+        and isinstance(node.value, ast.Name)
+        and node.value.id in _EVENT_NAMES
+    )
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of an attribute/subscript chain (``x.a[0].b`` → x)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FunctionScan:
+    def __init__(self, checker: "FrozenMutationChecker", rel: str, qual: str):
+        self.checker = checker
+        self.rel = rel
+        self.qual = qual
+        self.tainted: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    # -- taint bookkeeping ---------------------------------------------------
+
+    def _value_taints(self, value: ast.AST) -> bool:
+        if _is_source_call(value) or _is_event_object(value):
+            return True
+        # x = tainted / x = tainted[0] / x = tainted.field
+        root = _root_name(value)
+        return root is not None and root in self.tainted
+
+    def _assign(self, targets: List[ast.expr], value: ast.AST) -> None:
+        taints = self._value_taints(value)
+        # a clearing call always un-taints its targets, even when fed a
+        # tainted argument — that is the whole point of thaw()/deepcopy
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func) or ""
+            if callee.rsplit(".", 1)[-1] in _CLEARERS:
+                taints = False
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                (self.tainted.add if taints else self.tainted.discard)(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    if isinstance(el, ast.Name):
+                        (self.tainted.add if taints else self.tainted.discard)(el.id)
+            elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                root = _root_name(tgt)
+                if root in self.tainted:
+                    self._flag(tgt, f"{root}.{_describe(tgt)}=", tgt.lineno)
+
+    def _flag(self, node: ast.AST, detail: str, line: int) -> None:
+        self.findings.append(Finding(
+            checker=self.checker.name, relpath=self.rel, line=line,
+            qualname=self.qual, detail=detail,
+            message=(
+                f"write '{detail}' to an object from a frozen read path "
+                f"without thaw()/deepcopy"
+            ),
+        ))
+
+    # -- statement walk (source order) ---------------------------------------
+
+    def walk(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._assign(stmt.targets, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._assign([stmt.target], stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                root = _root_name(stmt.target)
+                if root in self.tainted and not isinstance(stmt.target, ast.Name):
+                    self._flag(stmt.target, f"{root}.{_describe(stmt.target)}+=",
+                               stmt.lineno)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                iter_root = _root_name(stmt.iter)
+                loop_taints = (
+                    (iter_root is not None and iter_root in self.tainted)
+                    or self._value_taints(stmt.iter)
+                )
+                for el in ast.walk(stmt.target):
+                    if isinstance(el, ast.Name):
+                        (self.tainted.add if loop_taints
+                         else self.tainted.discard)(el.id)
+            elif isinstance(stmt, ast.Expr):
+                self._check_mutator(stmt.value)
+            for body in _bodies(stmt):
+                self.walk(body)
+
+    def _check_mutator(self, expr: ast.AST) -> None:
+        if not (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute)):
+            return
+        if expr.func.attr not in _MUTATORS:
+            return
+        root = _root_name(expr.func.value)
+        if root is not None and root in self.tainted:
+            self._flag(expr, f"{root}.{expr.func.attr}()", expr.lineno)
+
+
+def _bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out = []
+    for name in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, name, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            out.append(b)
+    for h in getattr(stmt, "handlers", []) or []:
+        out.append(h.body)
+    return out
+
+
+def _describe(tgt: ast.AST) -> str:
+    if isinstance(tgt, ast.Attribute):
+        return tgt.attr
+    if isinstance(tgt, ast.Subscript):
+        return "[]"
+    return "?"
+
+
+class FrozenMutationChecker(Checker):
+    name = "frozen-mutation"
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:
+        for module in modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                qual = _qualname(module.tree, node)
+                scan = _FunctionScan(self, module.relpath, qual)
+                scan.walk(node.body)
+                yield from scan.findings
+
+
+def _qualname(tree: ast.Module, target: ast.AST) -> str:
+    """Class.method for methods, bare name otherwise (one level — the
+    repo does not nest classes)."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if item is target:
+                    return f"{node.name}.{target.name}"  # type: ignore[union-attr]
+    return target.name  # type: ignore[union-attr]

@@ -1,0 +1,149 @@
+"""lock-order: the whole-repo lock-acquisition graph must be acyclic,
+and the documented kind→commit order must hold as observed edges.
+
+Edges come from two places:
+
+- lexically nested ``with`` regions (``with self._kind_lock(k), self._lock:``
+  and the two-statement form both count);
+- calls made while holding a lock to same-class methods / same-module
+  functions, expanded through a transitive-acquisition fixpoint (so
+  ``create()`` holding the kind lock and calling ``self._commit`` —
+  which takes ``self._lock`` — yields the kind→commit edge without any
+  annotation).
+
+The documented order from the store docstring ("kind lock -> commit
+lock, never the reverse") is pinned as :data:`PINNED_EDGES`. A pinned
+edge must be OBSERVED (otherwise the pin has rotted and must be
+updated), and any cycle — including one a pinned edge participates in,
+i.e. somebody acquiring in the reverse order — is a finding that names
+the full cycle with one witness site per edge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.lint.base import Checker, Finding, Module
+from tools.lint.locks import ModuleLocks, transitive_locks
+
+# (outer, inner): the order the code must acquire in. Lock ids are
+# module-dotted (tfk8s_tpu/ prefix stripped): see tools/lint/locks.py.
+PINNED_EDGES: Tuple[Tuple[str, str], ...] = (
+    # ClusterStore: per-kind mutation lock, THEN the store-wide commit
+    # lock (which _compact_cv aliases). Never the reverse.
+    ("client.store.ClusterStore._kind_lock()", "client.store.ClusterStore._lock"),
+)
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+
+    def __init__(self, pinned: Optional[Sequence[Tuple[str, str]]] = None):
+        self.pinned = tuple(pinned if pinned is not None else PINNED_EDGES)
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:
+        mods = [ModuleLocks(m) for m in modules]
+        trans = transitive_locks(mods)
+
+        # edge -> witness (relpath, line, qualname); first witness wins
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        def add_edge(outer: str, inner: str, rel: str, line: int, qual: str) -> None:
+            if outer != inner:
+                edges.setdefault((outer, inner), (rel, line, qual))
+
+        for ml in mods:
+            rel = ml.module.relpath
+            for fn in ml.functions:
+                for outer, inner, line in fn.nested:
+                    add_edge(outer, inner, rel, line, fn.qualname)
+                for call in fn.calls:
+                    if not call.held or call.callee is None:
+                        continue
+                    # resolve same-class / same-module callees only
+                    key = None
+                    if call.callee.startswith("self.") and fn.cls:
+                        meth = call.callee[len("self."):]
+                        if "." not in meth:
+                            key = (ml.module.dotted, f"{fn.cls}.{meth}")
+                    elif "." not in call.callee:
+                        key = (ml.module.dotted, call.callee)
+                    if key is None or key not in trans:
+                        continue
+                    for inner in trans[key]:
+                        for outer in call.held:
+                            add_edge(outer, inner, rel, call.line, fn.qualname)
+
+        # 1. every pinned edge must be observed
+        for outer, inner in self.pinned:
+            if (outer, inner) not in edges:
+                yield Finding(
+                    checker=self.name,
+                    relpath="tools/lint/checkers/lock_order.py",
+                    line=1,
+                    qualname="PINNED_EDGES",
+                    detail=f"unobserved:{outer}->{inner}",
+                    message=(
+                        f"pinned lock order {outer} -> {inner} is no longer "
+                        f"observed anywhere — the documented order has rotted; "
+                        f"update PINNED_EDGES or restore the ordering site"
+                    ),
+                )
+
+        # 2. the graph (observed edges; pins are a subset once observed)
+        #    must be acyclic
+        for cycle in _cycles(edges):
+            witness_bits = []
+            for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+                rel, line, qual = edges[(a, b)]
+                witness_bits.append(f"{a} -> {b} at {rel}:{line} ({qual})")
+            rel, line, qual = edges[(cycle[0], cycle[1] if len(cycle) > 1 else cycle[0])]
+            yield Finding(
+                checker=self.name,
+                relpath=rel,
+                line=line,
+                qualname=qual,
+                detail="cycle:" + "->".join(cycle),
+                message=(
+                    "lock-order cycle (potential deadlock): "
+                    + "; ".join(witness_bits)
+                ),
+            )
+
+
+def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int, str]]) -> List[List[str]]:
+    """Elementary cycles via DFS back-edge detection, canonicalized
+    (rotated to min node, deduped) so each cycle reports once."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for succs in graph.values():
+        succs.sort()
+
+    seen_cycles = set()
+    out: List[List[str]] = []
+    color: Dict[str, int] = {}  # 0 absent, 1 on stack, 2 done
+    stack: List[str] = []
+
+    def dfs(node: str) -> None:
+        color[node] = 1
+        stack.append(node)
+        for nxt in graph[node]:
+            c = color.get(nxt, 0)
+            if c == 0:
+                dfs(nxt)
+            elif c == 1:
+                cyc = stack[stack.index(nxt):]
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    out.append(list(canon))
+        stack.pop()
+        color[node] = 2
+
+    for node in sorted(graph):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    return out

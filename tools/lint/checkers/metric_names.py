@@ -1,0 +1,65 @@
+"""metric-names: the /metrics namespace rules, folded into the lint
+framework from ``tools/check_metric_names.py`` (which stays importable
+and standalone-runnable — tests/test_metric_names.py pins its API).
+
+The RULES live in one place — this checker imports the regexes and
+sanitize/suffix logic from ``tools.check_metric_names`` and only adapts
+the scan loop to produce keyed :class:`Finding`\\ s, so the wanted-set
+tests and this checker can never disagree about what a valid name is.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from tools.check_metric_names import (
+    _CALL_RE,
+    _EXPOSED_NAME_RE,
+    _HIST_SUFFIXES,
+    _PLACEHOLDER_RE,
+    _sanitize,
+)
+from tools.lint.base import Checker, Finding, Module
+
+_SELF = "tools/check_metric_names.py"  # its docstring shows bad examples
+
+
+class MetricNamesChecker(Checker):
+    name = "metric-names"
+
+    def relevant(self, relpath: str) -> bool:
+        if relpath == _SELF:
+            return False
+        return (
+            relpath.startswith(("tfk8s_tpu/", "tools/"))
+            or relpath == "bench.py"
+        )
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:
+        for module in modules:
+            src = module.source
+            for m in _CALL_RE.finditer(src):
+                verb, raw = m.group("verb"), m.group("name")
+                line = src.count("\n", 0, m.start()) + 1
+                exposed = _sanitize(
+                    _PLACEHOLDER_RE.sub("x", raw) if m.group("fprefix") else raw
+                )
+                problem = None
+                if not _EXPOSED_NAME_RE.match(exposed):
+                    problem = f"exposes {exposed!r} — not snake_case"
+                elif verb == "inc" and not exposed.endswith("_total"):
+                    problem = "counter must end in _total"
+                elif verb == "observe" and not exposed.endswith(_HIST_SUFFIXES):
+                    problem = (
+                        "histogram must end in one of "
+                        + "/".join(_HIST_SUFFIXES)
+                    )
+                if problem is not None:
+                    yield Finding(
+                        checker=self.name,
+                        relpath=module.relpath,
+                        line=line,
+                        qualname="",
+                        detail=f"{verb}:{raw}",
+                        message=f"{verb}({raw!r}): {problem}",
+                    )

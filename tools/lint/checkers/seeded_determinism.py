@@ -1,0 +1,112 @@
+"""seeded-determinism: the seeded paths — data augmentation, the chaos
+harness, checkpoint discovery — must be pure functions of their seeds.
+A ``time.time()`` or module-state RNG call in one of them silently
+breaks replayability (same seed, different batch) and the elastic
+resume contract.
+
+Flagged inside the scoped files: ``time.time`` / ``time.time_ns`` /
+``datetime.now`` / ``utcnow``, ``uuid.uuid4``, ``os.urandom``,
+``secrets.*``, module-state ``random.*`` (``random.random``,
+``random.shuffle``...), and module-state ``np.random.*``
+(``np.random.rand``...).
+
+Explicitly allowed: constructing SEEDED generator objects —
+``random.Random(seed)``, ``np.random.default_rng(seed)``,
+``np.random.SeedSequence(entropy)`` / ``Generator`` / ``PCG64`` /
+``Philox`` / ``MT19937`` — and anything called on such an object,
+including inline chains like ``np.random.default_rng(seq).shuffle(x)``.
+The SAME constructors called with NO arguments are flagged: an argless
+``default_rng()`` / ``Random()`` / ``SeedSequence()`` pulls OS entropy,
+which is exactly the nondeterminism this checker exists to keep out.
+``time.monotonic`` / ``perf_counter`` are allowed: they are for
+durations and never persisted into data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.lint.base import Checker, Finding, Module, QualnameVisitor, dotted_name
+
+# the seeded paths; everything else may use wall clocks freely
+SCOPE_PREFIXES = (
+    "tfk8s_tpu/data/",
+    "tfk8s_tpu/runtime/checkpoint.py",
+    "tests/chaos.py",
+)
+
+_BANNED_EXACT = {
+    "time.time", "time.time_ns", "uuid.uuid4", "os.urandom",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+_BANNED_PREFIXES = ("secrets.",)
+_RNG_MODULES = ("random.", "np.random.", "numpy.random.")
+_ALLOWED_RNG_CONSTRUCTORS = {
+    "random.Random",
+    "np.random.default_rng", "numpy.random.default_rng",
+    "np.random.SeedSequence", "numpy.random.SeedSequence",
+    "np.random.Generator", "numpy.random.Generator",
+    "np.random.PCG64", "numpy.random.PCG64",
+    "np.random.Philox", "numpy.random.Philox",
+    "np.random.MT19937", "numpy.random.MT19937",
+}
+
+
+class _CallVisitor(QualnameVisitor):
+    def __init__(self, checker: "SeededDeterminismChecker", module: Module):
+        super().__init__()
+        self.checker = checker
+        self.module = module
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = dotted_name(node.func)
+        if callee is not None and self._banned(node, callee):
+            self.findings.append(Finding(
+                checker=self.checker.name,
+                relpath=self.module.relpath,
+                line=node.lineno,
+                qualname=self.qualname,
+                detail=f"call:{callee}",
+                message=(
+                    f"{callee}() in a seeded path — wall clock / module-state "
+                    f"RNG breaks same-seed replay; use the injected generator "
+                    f"or an explicit seed"
+                ),
+            ))
+        self.generic_visit(node)
+
+    def _banned(self, node: ast.Call, callee: str) -> bool:
+        if callee in _BANNED_EXACT:
+            return True
+        if callee.startswith(_BANNED_PREFIXES):
+            return True
+        if callee in _ALLOWED_RNG_CONSTRUCTORS:
+            # the constructor itself: seeded ok, argless = OS entropy
+            return not (node.args or node.keywords)
+        # a method chained off a constructed generator:
+        # np.random.default_rng(seq).shuffle(x) — allowed iff the inner
+        # constructor call is seeded (the inner Call is visited
+        # separately and catches the argless case, so don't double-flag)
+        for ctor in _ALLOWED_RNG_CONSTRUCTORS:
+            if callee.startswith(ctor + "()."):
+                return False
+        return callee.startswith(_RNG_MODULES)
+
+
+class SeededDeterminismChecker(Checker):
+    name = "seeded-determinism"
+
+    def __init__(self, scope_prefixes=SCOPE_PREFIXES):
+        self.scope_prefixes = tuple(scope_prefixes)
+
+    def relevant(self, relpath: str) -> bool:
+        return relpath.startswith(self.scope_prefixes)
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:
+        for module in modules:
+            visitor = _CallVisitor(self, module)
+            visitor.visit(module.tree)
+            yield from visitor.findings

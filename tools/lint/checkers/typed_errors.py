@@ -1,0 +1,155 @@
+"""typed-errors: every ``raise`` on the wire-facing paths — apiserver
+request handlers, ServeClient submit, executor/report paths — must be
+an exception from the typed taxonomy, because those are the only
+classes the transport layers know how to map to status codes
+(``_send_store_error``) or to the serve retry contract.
+
+The taxonomy is collected from the tree itself: seed roots
+(``StoreError``, ``ServeError``, ``ValidationError``,
+``FrozenObjectError``, ``PodDrained``, ``OutOfPages``,
+``TopologyError``, ``_AdmissionRejected``) plus every class whose base
+chain reaches one of them (so ``DeadlineExceeded(ServeError,
+TimeoutError)`` is typed by virtue of the ``ServeError`` base).
+``raise e``-style re-raises of caught variables and bare ``raise`` are
+always allowed; ``NotImplementedError``/``AssertionError`` are treated
+as programmer-contract errors, not wire errors, and allowed. Error
+FACTORIES are resolved too: ``raise _map_error(status, ...)`` is fine
+because every ``return`` in ``_map_error`` constructs a taxonomy class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from tools.lint.base import Checker, Finding, Module, QualnameVisitor, dotted_name
+
+# files whose raise sites are reachable from the wire paths
+SCOPE = (
+    "tfk8s_tpu/client/apiserver.py",
+    "tfk8s_tpu/client/remote.py",
+    "tfk8s_tpu/client/store.py",
+    "tfk8s_tpu/runtime/server.py",
+    "tfk8s_tpu/runtime/registry.py",
+    "tfk8s_tpu/runtime/paging.py",
+)
+
+SEED_ROOTS = {
+    "StoreError", "ServeError", "ValidationError", "FrozenObjectError",
+    "PodDrained", "OutOfPages", "TopologyError", "_AdmissionRejected",
+}
+# contract violations by the CALLER'S programmer, not wire errors
+CONTRACT_ERRORS = {"NotImplementedError", "AssertionError", "StopIteration"}
+
+
+def collect_taxonomy(modules: List[Module]) -> Set[str]:
+    """Seed roots + every class transitively deriving from one,
+    anywhere in the linted tree."""
+    bases = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                base_names = []
+                for b in node.bases:
+                    name = dotted_name(b)
+                    if name:
+                        base_names.append(name.rsplit(".", 1)[-1])
+                bases.setdefault(node.name, set()).update(base_names)
+    allowed = set(SEED_ROOTS)
+    changed = True
+    while changed:
+        changed = False
+        for cls, cls_bases in bases.items():
+            if cls not in allowed and cls_bases & allowed:
+                allowed.add(cls)
+                changed = True
+
+    # error factories: a function is as typed as its returns — if every
+    # `return` constructs an allowed class, raising the factory's result
+    # is allowed (fixpoint so factories may call factories)
+    returns = {}
+    for module in modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            rets = []
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    callee = (
+                        dotted_name(sub.value.func)
+                        if isinstance(sub.value, ast.Call) else None
+                    )
+                    rets.append(callee.rsplit(".", 1)[-1] if callee else None)
+            if rets and all(r is not None for r in rets):
+                returns[node.name] = set(rets)
+    changed = True
+    while changed:
+        changed = False
+        for fn, ret_names in returns.items():
+            if fn not in allowed and ret_names <= allowed:
+                allowed.add(fn)
+                changed = True
+    return allowed
+
+
+class _RaiseVisitor(QualnameVisitor):
+    def __init__(self, checker: "TypedErrorsChecker", module: Module,
+                 allowed: Set[str]):
+        super().__init__()
+        self.checker = checker
+        self.module = module
+        self.allowed = allowed
+        self.findings: List[Finding] = []
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        exc = node.exc
+        name = None
+        if exc is None:
+            pass  # bare re-raise
+        elif isinstance(exc, ast.Call):
+            callee = dotted_name(exc.func)
+            name = callee.rsplit(".", 1)[-1] if callee else None
+        elif isinstance(exc, ast.Name):
+            # `raise err` re-raise of a variable vs `raise ValueError`
+            name = exc.id if exc.id[:1].isupper() else None
+        elif isinstance(exc, ast.Attribute):
+            name = exc.attr if exc.attr[:1].isupper() else None
+        if (
+            name is not None
+            and name not in self.allowed
+            and name not in CONTRACT_ERRORS
+        ):
+            self.findings.append(Finding(
+                checker=self.checker.name,
+                relpath=self.module.relpath,
+                line=node.lineno,
+                qualname=self.qualname,
+                detail=f"raise:{name}",
+                message=(
+                    f"raise {name} on a wire-facing path — use a class from "
+                    f"the typed taxonomy (StoreError/ServeError/... tree) so "
+                    f"transports can map it"
+                ),
+            ))
+        self.generic_visit(node)
+
+
+class TypedErrorsChecker(Checker):
+    name = "typed-errors"
+
+    def __init__(self, scope=SCOPE):
+        self.scope = tuple(scope)
+
+    def relevant(self, relpath: str) -> bool:
+        # taxonomy collection needs the whole package; raise-site
+        # scoping to self.scope happens in check()
+        return relpath.startswith("tfk8s_tpu/")
+
+    def check(self, modules: List[Module]) -> Iterable[Finding]:
+        allowed = collect_taxonomy(modules)
+        for module in modules:
+            if module.relpath not in self.scope:
+                continue
+            visitor = _RaiseVisitor(self, module, allowed)
+            visitor.visit(module.tree)
+            yield from visitor.findings

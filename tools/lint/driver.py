@@ -1,0 +1,150 @@
+"""The one lint driver: discover → parse once → run every checker →
+apply suppressions.
+
+``run_lint`` is the in-process API tier-1 uses (no subprocess per
+checker); ``python -m tools.lint`` (``tools/lint/__main__.py``) is the
+same call with argv plumbing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.lint.base import Checker, Finding, Module, Suppression
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_SUPPRESSIONS = os.path.join(_HERE, "suppressions.txt")
+
+
+def default_paths() -> List[str]:
+    """The scope tier-1 enforces: the package, the tools, the repo-root
+    bench script (metric registrations), and the seeded chaos harness
+    (tests/chaos.py — the one tests/ file carrying a seeded-path
+    invariant). Checkers narrow further via ``Checker.relevant``."""
+    return [
+        os.path.join(REPO_ROOT, "tfk8s_tpu"),
+        os.path.join(REPO_ROOT, "tools"),
+        os.path.join(REPO_ROOT, "bench.py"),
+        os.path.join(REPO_ROOT, "tests", "chaos.py"),
+    ]
+
+
+def _discover(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """(abspath, relpath) for every .py under ``paths``, sorted by
+    relpath so output and graph construction are deterministic."""
+    out: Dict[str, str] = {}
+    for root in paths:
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            out[os.path.relpath(root, REPO_ROOT).replace(os.sep, "/")] = root
+            continue
+        for dirpath, dirnames, names in os.walk(root):
+            dirnames[:] = [d for d in dirnames if not d.startswith((".", "__pycache__"))]
+            for n in names:
+                if n.endswith(".py"):
+                    p = os.path.join(dirpath, n)
+                    out[os.path.relpath(p, REPO_ROOT).replace(os.sep, "/")] = p
+    return sorted((rel, p) for rel, p in out.items())
+
+
+def load_modules(paths: Sequence[str]) -> Tuple[List[Module], List[str]]:
+    """Parse every discovered file once. Unparseable files are reported
+    as errors (a syntax error must fail the lint, not hide code from
+    it)."""
+    modules: List[Module] = []
+    errors: List[str] = []
+    for rel, p in _discover(paths):
+        with open(p, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            errors.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        modules.append(Module(path=p, relpath=rel, tree=tree, source=src))
+    return modules, errors
+
+
+def load_suppressions(path: str = DEFAULT_SUPPRESSIONS) -> Tuple[List[Suppression], List[str]]:
+    """Parse the suppressions file. Format, one per line::
+
+        <checker>:<relpath>:<qualname>:<detail>  # why this is acceptable
+
+    Globs are allowed in every field. The reason is MANDATORY — a key
+    with no ``#`` comment is itself reported as a lint problem."""
+    sups: List[Suppression] = []
+    errors: List[str] = []
+    if not os.path.exists(path):
+        return sups, errors
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            pattern, _, reason = line.partition("#")
+            pattern, reason = pattern.strip(), reason.strip()
+            if not reason:
+                errors.append(
+                    f"suppressions.txt:{lineno}: suppression without a "
+                    f"reason (add '# why'): {pattern!r}"
+                )
+                continue
+            if pattern.count(":") < 3:
+                errors.append(
+                    f"suppressions.txt:{lineno}: malformed key (need "
+                    f"checker:relpath:qualname:detail): {pattern!r}"
+                )
+                continue
+            sups.append(Suppression(pattern=pattern, reason=reason, lineno=lineno))
+    return sups, errors
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # unsuppressed
+    suppressed: List[Tuple[Finding, Suppression]] = field(default_factory=list)
+    unused_suppressions: List[Suppression] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)  # parse/format problems
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+    @property
+    def clean(self) -> bool:
+        """ok AND no dead weight in the suppressions file — the bar the
+        tier-1 test holds the tree to."""
+        return self.ok and not self.unused_suppressions
+
+
+def run_lint(
+    paths: Optional[Sequence[str]] = None,
+    checkers: Optional[Sequence[Checker]] = None,
+    suppressions_path: str = DEFAULT_SUPPRESSIONS,
+    suppress: bool = True,
+) -> LintResult:
+    from tools.lint.checkers import all_checkers
+
+    result = LintResult()
+    modules, errors = load_modules(paths or default_paths())
+    result.errors.extend(errors)
+    sups: List[Suppression] = []
+    if suppress:
+        sups, sup_errors = load_suppressions(suppressions_path)
+        result.errors.extend(sup_errors)
+    for checker in checkers if checkers is not None else all_checkers():
+        scoped = [m for m in modules if checker.relevant(m.relpath)]
+        for finding in checker.check(scoped):
+            hit = next((s for s in sups if s.matches(finding.key)), None)
+            if hit is not None:
+                hit.used = True
+                result.suppressed.append((finding, hit))
+            else:
+                result.findings.append(finding)
+    result.unused_suppressions = [s for s in sups if not s.used]
+    result.findings.sort(key=lambda f: (f.relpath, f.line, f.checker))
+    return result

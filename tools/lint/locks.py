@@ -1,0 +1,263 @@
+"""Lock-region extraction shared by the lock-order and
+blocking-under-lock checkers.
+
+What counts as a lock acquisition (the repo's idioms, all of them
+``with``-based — bare ``.acquire()`` is not used and stays un-modeled):
+
+- ``with self._lock:`` / ``with self._cond:`` — an instance attribute
+  whose final name segment is lock-ish (``lock``/``cond``/``mutex``/
+  ``cv``, optionally underscore-prefixed, any case);
+- ``with self._kind_lock(kind):`` — a lock-returning method (same
+  name rule), identified per METHOD, not per returned instance: the
+  kind-lock family is one rung in the documented order;
+- ``with _metrics_lock:`` — a module-global lock name.
+
+Lock identity is ``<module-dotted>.<Class>.<attr>`` (or ``...<meth>()``
+for lock factories, ``<module-dotted>.<name>`` for globals).
+``threading.Condition(self._lock)`` aliases the condition attribute to
+the lock it wraps, so waiting on the condition is recognized as using
+the same underlying lock (the store's ``_compact_cv``).
+
+The analysis is intentionally lexical-plus-one-hop: nested ``with``
+regions give direct edges, and calls to methods of the SAME class (or
+functions of the same module) made while holding a lock contribute the
+callee's transitively-acquired locks as edges. Cross-object attribute
+calls are not resolved — that keeps the graph sound on the idioms the
+repo actually uses without a type system.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.base import Module, dotted_name
+
+_LOCKISH = re.compile(r"(?:^|_)(?:lock|locks?|cond|mutex|cv)$", re.IGNORECASE)
+
+
+def is_lockish_name(name: str) -> bool:
+    return bool(_LOCKISH.search(name))
+
+
+@dataclass
+class Acquisition:
+    """One ``with <lock>`` region."""
+
+    lock: str  # canonical lock id (alias-resolved)
+    node: ast.With  # the with statement
+    body: List[ast.stmt]
+    line: int
+
+
+@dataclass
+class CallSite:
+    callee: Optional[str]  # dotted callee ('self.f', 'mod.f', 'f', ...)
+    node: ast.Call
+    held: Tuple[str, ...]  # locks held (outermost first), deduped
+    line: int
+
+
+@dataclass
+class FunctionInfo:
+    module: Module
+    qualname: str  # Class.method or function name
+    cls: Optional[str]
+    node: ast.AST
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    # every call in the body, with the lock stack held at that point
+    calls: List[CallSite] = field(default_factory=list)
+    # (outer, inner, line) for lexically nested with-lock pairs
+    nested: List[Tuple[str, str, int]] = field(default_factory=list)
+    # locks acquired anywhere in this function, directly
+    direct_locks: Set[str] = field(default_factory=set)
+    # names of same-class methods / same-module functions called anywhere
+    local_callees: Set[str] = field(default_factory=set)
+
+
+def _class_aliases(cls: ast.ClassDef) -> Dict[str, str]:
+    """``self.X = threading.Condition(self.Y)`` → {X: Y} (anywhere in the
+    class body; in practice ``__init__``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt, val = node.targets[0], node.value
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+            and isinstance(val, ast.Call)
+        ):
+            continue
+        callee = dotted_name(val.func)
+        if callee in ("threading.Condition", "Condition") and val.args:
+            src = val.args[0]
+            if (
+                isinstance(src, ast.Attribute)
+                and isinstance(src.value, ast.Name)
+                and src.value.id == "self"
+            ):
+                aliases[tgt.attr] = src.attr
+    return aliases
+
+
+class ModuleLocks:
+    """All lock-relevant facts of one module."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.functions: List[FunctionInfo] = []
+        self._aliases: Dict[str, Dict[str, str]] = {}  # class -> attr alias map
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._aliases[node.name] = _class_aliases(node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._scan_function(item, cls=node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(node, cls=None)
+
+    # -- lock identification ------------------------------------------------
+
+    def lock_id(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        """Canonical lock id for a with-item / wait-receiver expression,
+        or None when it isn't lock-shaped."""
+        mod = self.module.dotted
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and cls is not None:
+                attr = expr.attr
+                seen = set()
+                while attr in self._aliases.get(cls, {}) and attr not in seen:
+                    seen.add(attr)
+                    attr = self._aliases[cls][attr]
+                if is_lockish_name(attr):
+                    return f"{mod}.{cls}.{attr}"
+                return None
+        if isinstance(expr, ast.Call):
+            callee = expr.func
+            if (
+                isinstance(callee, ast.Attribute)
+                and isinstance(callee.value, ast.Name)
+                and callee.value.id == "self"
+                and cls is not None
+                and is_lockish_name(callee.attr)
+            ):
+                return f"{mod}.{cls}.{callee.attr}()"
+            return None
+        if isinstance(expr, ast.Name) and is_lockish_name(expr.id):
+            return f"{mod}.{expr.id}"
+        return None
+
+    # -- per-function scan ----------------------------------------------------
+
+    def _scan_function(self, fn: ast.AST, cls: Optional[str]) -> None:
+        qual = f"{cls}.{fn.name}" if cls else fn.name
+        info = FunctionInfo(module=self.module, qualname=qual, cls=cls, node=fn)
+        self._walk(fn.body, info, held=[])
+        self.functions.append(info)
+
+    def _walk(self, stmts: List[ast.stmt], info: FunctionInfo, held: List[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later, not under this lock
+            if isinstance(stmt, ast.With):
+                locks_here: List[str] = []
+                for item in stmt.items:
+                    lock = self.lock_id(item.context_expr, info.cls)
+                    if lock is not None:
+                        info.direct_locks.add(lock)
+                        for outer in held + locks_here:
+                            if outer != lock:
+                                info.nested.append((outer, lock, stmt.lineno))
+                        locks_here.append(lock)
+                        info.acquisitions.append(
+                            Acquisition(
+                                lock=lock, node=stmt, body=stmt.body,
+                                line=stmt.lineno,
+                            )
+                        )
+                    else:
+                        # the with-item EXPRESSION evaluates before any
+                        # acquisition in this statement (open(...) etc.)
+                        self._scan_calls(item.context_expr, info, held)
+                self._walk(stmt.body, info, held + locks_here)
+                continue
+            # every other compound statement: collect calls in the
+            # non-body expressions, then recurse into bodies in order
+            for child_body in _stmt_bodies(stmt):
+                self._walk(child_body, info, held)
+            for expr in _stmt_exprs(stmt):
+                self._scan_calls(expr, info, held)
+
+    def _scan_calls(self, expr: ast.AST, info: FunctionInfo, held: List[str]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            dedup: Tuple[str, ...] = tuple(dict.fromkeys(held))
+            info.calls.append(
+                CallSite(callee=callee, node=node, held=dedup, line=node.lineno)
+            )
+            if callee is not None:
+                if callee.startswith("self."):
+                    parts = callee.split(".")
+                    if len(parts) == 2:
+                        info.local_callees.add(parts[1])
+                elif "." not in callee:
+                    info.local_callees.add(callee)
+
+
+def _stmt_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out = []
+    for name in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, name, None)
+        if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+            out.append(b)
+    for h in getattr(stmt, "handlers", []) or []:
+        out.append(h.body)
+    return out
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expression children of a statement that are NOT nested
+    statement bodies (test/iter/targets/value...)."""
+    out: List[ast.AST] = []
+    for field_name, value in ast.iter_fields(stmt):
+        if field_name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            out.append(value)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.AST) and not isinstance(v, ast.stmt))
+    return out
+
+
+def transitive_locks(mods: List[ModuleLocks]) -> Dict[Tuple[str, str], Set[str]]:
+    """(module.dotted, qualname) → every lock the function may acquire,
+    including through same-class / same-module calls (fixpoint)."""
+    by_key: Dict[Tuple[str, str], FunctionInfo] = {}
+    for ml in mods:
+        for fn in ml.functions:
+            by_key[(ml.module.dotted, fn.qualname)] = fn
+    acq: Dict[Tuple[str, str], Set[str]] = {
+        k: set(fn.direct_locks) for k, fn in by_key.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, fn in by_key.items():
+            mod = key[0]
+            for callee in fn.local_callees:
+                for target in (
+                    (mod, f"{fn.cls}.{callee}") if fn.cls else None,
+                    (mod, callee),
+                ):
+                    if target and target in acq:
+                        before = len(acq[key])
+                        acq[key] |= acq[target]
+                        if len(acq[key]) != before:
+                            changed = True
+    return acq

@@ -1,0 +1,194 @@
+"""Malformed-input smoke corpus for the sanitized native cores.
+
+Run INSIDE the sanitizer environment (tests/test_sanitizers.py is the
+harness that sets it up)::
+
+    TFK8S_NATIVE_SANITIZE=ubsan python -m tools.sanitize_smoke
+    TFK8S_NATIVE_SANITIZE=asan LD_PRELOAD=$(gcc -print-file-name=libasan.so) \\
+        ASAN_OPTIONS=detect_leaks=0 python -m tools.sanitize_smoke
+
+The corpus is generated, not checked in, and fully deterministic: a
+valid record shard / JPEG, then systematic truncations, bit flips, a
+lying length field, a lying geometry stamp, and pure garbage. Every
+input is driven through the native entry points (``rio_index`` /
+``rio_read`` via RecordFile, ``img_info`` / ``img_decode_scaled`` /
+``img_decode_rrc`` via the binder). The CONTRACT under test: malformed
+bytes produce a typed refusal (RecordIOError / None / False), never a
+sanitizer report — asan/ubsan turn any out-of-bounds parse into a
+process abort, which the harness surfaces with the sanitizer's own
+stack trace.
+
+Exit 0: corpus survived. Exit 1: a core accepted what it should have
+refused, or refused what it must accept. Sanitizer aborts exit with the
+sanitizer's status and report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+import tempfile
+import traceback
+from typing import Callable, List
+
+
+def _mutations(valid: bytes) -> List[bytes]:
+    """The shared corpus shape: truncations sweeping the whole file,
+    single-bit flips sweeping header and tail regions, and garbage."""
+    out: List[bytes] = []
+    step = max(1, len(valid) // 64)
+    out.extend(valid[:n] for n in range(0, len(valid), step))
+    for pos in list(range(0, min(64, len(valid)))) + list(
+        range(max(0, len(valid) - 16), len(valid))
+    ):
+        flipped = bytearray(valid)
+        flipped[pos] ^= 0x40
+        out.append(bytes(flipped))
+    out.append(b"")
+    out.append(b"\xff" * 257)
+    out.append(bytes(range(256)) * 5)
+    return out
+
+
+def smoke_recordio(tmp: str) -> int:
+    from tfk8s_tpu.data import _native
+    from tfk8s_tpu.data.recordio import RecordFile, RecordIOError, RecordWriter
+
+    if _native.load() is None:
+        print("recordio: native core not loaded — nothing to smoke")
+        return 0
+
+    shard = os.path.join(tmp, "valid.rio")
+    with RecordWriter(shard) as w:
+        for i in range(32):
+            w.write(bytes([i]) * (i * 7 + 1))
+    valid = open(shard, "rb").read()
+
+    corpus = _mutations(valid)
+    # a lying length field: claims a record body far past EOF
+    lying = bytearray(valid)
+    huge = struct.pack("<Q", 2**40)
+    lying[0:8] = huge
+    corpus.append(bytes(lying))
+
+    failures = 0
+    for i, blob in enumerate(corpus):
+        path = os.path.join(tmp, "case.rio")
+        with open(path, "wb") as f:
+            f.write(blob)
+        try:
+            rf = RecordFile(path)
+            rf.read(range(len(rf)), verify=True)
+        except RecordIOError:
+            pass  # the typed refusal — exactly the contract
+        except Exception:
+            print(f"recordio case {i} ({len(blob)} bytes): WRONG error type")
+            traceback.print_exc()
+            failures += 1
+    # and the valid shard must still round-trip
+    rf = RecordFile(shard)
+    got = rf.read(range(len(rf)))
+    want = [bytes([i]) * (i * 7 + 1) for i in range(32)]
+    if got != want:
+        print("recordio: valid shard did not round-trip under sanitizer")
+        failures += 1
+    print(f"recordio: {len(corpus)} corpus cases, {failures} failure(s)")
+    return failures
+
+
+def smoke_imagecore(tmp: str) -> int:
+    import numpy as np
+
+    from tfk8s_tpu.data.images import _native_decode as nd
+
+    if nd.load() is None:
+        print("imagecore: native core not loaded — nothing to smoke")
+        return 0
+
+    try:
+        from PIL import Image
+    except ImportError:
+        print("imagecore: PIL unavailable — cannot generate the corpus")
+        return 0
+
+    # deterministic gradient frame -> real JPEG bytes
+    h, w = 97, 131
+    y, x = np.mgrid[0:h, 0:w]
+    frame = np.stack(
+        [(x * 2) % 256, (y * 3) % 256, (x + y) % 256], axis=-1
+    ).astype(np.uint8)
+    jpg_path = os.path.join(tmp, "valid.jpg")
+    Image.fromarray(frame).save(jpg_path, "JPEG", quality=90)
+    valid = open(jpg_path, "rb").read()
+
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+
+    def drive(blob: bytes, stamp=(h, w)) -> None:
+        nd.jpeg_info(blob)
+        for s in (8, 4, 3, 1):
+            nd.decode_jpeg_scaled(blob, s)
+        dst = np.empty((32, 32, 3), np.float32)
+        nd.decode_rrc_into(
+            blob, (5, 5, 48, 48), 32, True, 8, scale, bias, dst, stamp
+        )
+
+    failures = 0
+    corpus = _mutations(valid)
+    for i, blob in enumerate(corpus):
+        try:
+            drive(blob)
+        except Exception:
+            print(f"imagecore case {i} ({len(blob)} bytes): unexpected raise")
+            traceback.print_exc()
+            failures += 1
+    # the lying-geometry stamp: header says 97x131, caller claims a tiny
+    # frame (undersized scratch) and a huge one — both must be refusals
+    # or correct decodes, never a scratch overflow
+    for stamp in ((8, 8), (4000, 4000)):
+        try:
+            drive(valid, stamp=stamp)
+        except Exception:
+            print(f"imagecore lying stamp {stamp}: unexpected raise")
+            traceback.print_exc()
+            failures += 1
+    # and the valid image must still decode
+    out = nd.decode_jpeg(valid)
+    if out is None or out.shape != (h, w, 3):
+        print("imagecore: valid JPEG no longer decodes under sanitizer")
+        failures += 1
+    print(f"imagecore: {len(corpus) + 2} corpus cases, {failures} failure(s)")
+    return failures
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.sanitize_smoke")
+    ap.add_argument("--core", choices=["recordio", "imagecore", "all"],
+                    default="all")
+    args = ap.parse_args(argv)
+
+    from tfk8s_tpu.data import _native
+
+    if _native.sanitize_mode() is None:
+        print("refusing to run: set TFK8S_NATIVE_SANITIZE=asan|ubsan "
+              "(an unsanitized smoke run proves nothing)", file=sys.stderr)
+        return 2
+
+    cores: List[Callable[[str], int]] = []
+    if args.core in ("recordio", "all"):
+        cores.append(smoke_recordio)
+    if args.core in ("imagecore", "all"):
+        cores.append(smoke_imagecore)
+
+    failures = 0
+    with tempfile.TemporaryDirectory(prefix="tfk8s-sanitize-") as tmp:
+        for core in cores:
+            failures += core(tmp)
+    print("sanitize smoke:", "FAIL" if failures else "ok")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
