@@ -668,6 +668,230 @@ def _serving_probe(small: bool, full: bool = False):
     }
 
 
+def _gateway_probe(small: bool, full: bool = False):
+    """Gateway front-door throughput (ISSUE 10): the serving sweep's
+    open-loop offered-QPS ladder driven THROUGH THE WIRE — a real
+    GatewayServer on a real socket, real keep-alive GatewayClients,
+    least-loaded routing over replicas the actual controller + kubelet
+    brought up — against an in-process ServeClient baseline on the SAME
+    replica set at the same rates (acceptance: wire >= 70% of in-process
+    at the top offered rate). Then a fairness round: well-behaved
+    tenants' served QPS measured alone and again with one tenant
+    offering 10x its quota — ``gateway_fairness_ratio`` is with/without
+    (acceptance: the abuser costs the innocent < 10%). Every shed must
+    arrive typed; ``gateway_shed_untyped`` counts wire errors outside
+    the taxonomy and must be 0."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import tfk8s_tpu.runtime.kubelet as kubelet_mod
+    import tfk8s_tpu.trainer.serve_controller as sc_mod
+    from tfk8s_tpu.api.types import (
+        BatchingPolicy,
+        ObjectMeta,
+        TPUServe,
+        TPUServeSpec,
+    )
+    from tfk8s_tpu.client import FakeClientset
+    from tfk8s_tpu.client.store import StoreError
+    from tfk8s_tpu.gateway.client import GatewayClient
+    from tfk8s_tpu.gateway.server import GatewayServer
+    from tfk8s_tpu.runtime import LocalKubelet
+    from tfk8s_tpu.runtime.server import ServeClient, ServeError
+    from tfk8s_tpu.trainer import TPUServeController
+    from tfk8s_tpu.utils.logging import Metrics
+
+    small_mode = small and not full
+    if small_mode:
+        rates, dur = (100, 400), 1.0
+        fair_dur, good_rate, abuse_quota = 1.0, 50, 10.0
+    else:
+        rates, dur = (250, 1000, 4000), 3.0
+        fair_dur, good_rate, abuse_quota = 2.0, 100, 20.0
+    replicas, delay_ms = 2, 1.0
+
+    flush0 = kubelet_mod.LOG_FLUSH_SECONDS
+    period0 = sc_mod.AUTOSCALE_PERIOD_S
+    kubelet_mod.LOG_FLUSH_SECONDS = 0.05
+    sc_mod.AUTOSCALE_PERIOD_S = 0.1
+    cs = FakeClientset()
+    ctrl = TPUServeController(cs)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    ctrl.run(workers=2, stop=stop, block=False)
+    gw = GatewayServer(cs, port=0, metrics=Metrics())
+    gw.serve_background()
+    name = "bench-gw"
+    try:
+        serve = TPUServe(
+            metadata=ObjectMeta(name=name),
+            spec=TPUServeSpec(
+                task="echo", checkpoint="v1", replicas=replicas,
+                batching=BatchingPolicy(
+                    max_batch_size=16, batch_timeout_ms=2.0, queue_limit=64
+                ),
+            ),
+        )
+        serve.spec.template.env["TFK8S_SERVE_ECHO_DELAY_MS"] = str(delay_ms)
+        cs.tpuserves().create(serve)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if cs.tpuserves().get(name).status.ready_replicas == replicas:
+                    break
+            except StoreError:
+                pass
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("gateway bench replicas never became Ready")
+
+        shed = {"typed": 0, "untyped": 0}
+        shed_lock = threading.Lock()
+
+        def one_timed(request_fn):
+            t0 = time.perf_counter()
+            try:
+                request_fn()
+                return time.perf_counter() - t0
+            except (ServeError, StoreError):
+                with shed_lock:
+                    shed["typed"] += 1
+                return None
+            except Exception:  # noqa: BLE001 — an UNtyped wire error
+                with shed_lock:
+                    shed["untyped"] += 1
+                return None
+
+        def sweep_with(request_fn):
+            # same open-loop pacing as _serving_probe: the clock, not the
+            # responses, paces submission
+            sweep = []
+            for rate in rates:
+                n = int(rate * dur)
+                interval = 1.0 / rate
+                futs = []
+                # 64 submitters, not 128: at ~4ms/request 64 covers 4x the
+                # top offered rate, and every extra idle thread costs GIL
+                # handoffs that the single-process wire path pays twice
+                # (client and server threads share the interpreter)
+                with ThreadPoolExecutor(max_workers=64) as pool:
+                    t_start = time.perf_counter()
+                    for i in range(n):
+                        target = t_start + i * interval
+                        now = time.perf_counter()
+                        if target > now:
+                            time.sleep(target - now)
+                        futs.append(pool.submit(one_timed, request_fn))
+                    results = [f.result() for f in futs]
+                    elapsed = time.perf_counter() - t_start
+                lat = sorted(r for r in results if r is not None)
+                sweep.append({
+                    "offered_qps": rate,
+                    "achieved_qps": round(len(lat) / elapsed, 1),
+                    "p50_ms": round(lat[len(lat) // 2] * 1000, 3)
+                    if lat else None,
+                    "p99_ms": round(
+                        lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1000, 3
+                    ) if lat else None,
+                    "shed": len(results) - len(lat),
+                })
+            return sweep
+
+        wire_client = GatewayClient(gw.url, name)
+        wire_client.request(1.0, timeout=30)  # warm route table + socket
+        wire = sweep_with(lambda: wire_client.request(1.0, timeout=10))
+        inproc_client = ServeClient(cs, name)
+        inproc = sweep_with(lambda: inproc_client.request(1.0, timeout=10))
+
+        # -- fairness round: N tenants, then the same N plus one tenant
+        # offering 10x ITS quota — its excess must die at its own bucket,
+        # not in the queue everyone shares
+        cs.tpuserves().patch(name, {"spec": {"tenancy": {
+            "enabled": True,
+            "defaultQuota": {"qps": 100000.0, "burst": 1024},
+            "tenants": {
+                "abuser": {"qps": abuse_quota, "burst": int(abuse_quota)},
+            },
+        }}})
+        time.sleep(1.2)  # past the gateway's spec TTL: policy picked up
+
+        def drive(tenant, rate, out):
+            client = GatewayClient(gw.url, name, tenant=tenant)
+            n = int(rate * fair_dur)
+            interval = 1.0 / rate
+
+            def one():
+                # short deadline: an over-quota request sheds instead of
+                # riding retries to success (the abuser stays abusive)
+                return one_timed(
+                    lambda: client.request(1.0, timeout=0.2)
+                ) is not None
+
+            with ThreadPoolExecutor(max_workers=32) as pool:
+                t_start = time.perf_counter()
+                futs = []
+                for i in range(n):
+                    target = t_start + i * interval
+                    now = time.perf_counter()
+                    if target > now:
+                        time.sleep(target - now)
+                    futs.append(pool.submit(one))
+                out[tenant] = sum(f.result() for f in futs)
+            client.close()
+
+        def fairness_round(tenant_rates):
+            out = {}
+            threads = [
+                threading.Thread(target=drive, args=(t, r, out), daemon=True)
+                for t, r in tenant_rates
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return out
+
+        good = [("good-0", good_rate), ("good-1", good_rate)]
+        alone = fairness_round(good)
+        contended = fairness_round(good + [("abuser", abuse_quota * 10)])
+        good_alone = sum(alone[t] for t, _ in good)
+        good_contended = sum(contended[t] for t, _ in good)
+        fairness = good_contended / max(good_alone, 1)
+
+        wire_client.close()
+        best = max(wire, key=lambda r: r["achieved_qps"])
+        top_wire, top_inproc = wire[-1], inproc[-1]
+        return {
+            "gateway_model": "echo",
+            "gateway_replicas": replicas,
+            "gateway_echo_delay_ms": delay_ms,
+            "gateway_sweep": wire,
+            "gateway_inprocess_sweep": inproc,
+            "gateway_qps": best["achieved_qps"],
+            "gateway_p50_ms": best["p50_ms"],
+            "gateway_p99_ms": best["p99_ms"],
+            "gateway_inprocess_qps": top_inproc["achieved_qps"],
+            "gateway_wire_efficiency": round(
+                top_wire["achieved_qps"] / max(top_inproc["achieved_qps"], 1),
+                3,
+            ),
+            "gateway_fairness_ratio": round(fairness, 3),
+            "gateway_served_good_alone": good_alone,
+            "gateway_served_good_with_abuser": good_contended,
+            "gateway_abuser_served": contended["abuser"],
+            "gateway_shed_typed": shed["typed"],
+            "gateway_shed_untyped": shed["untyped"],
+        }
+    finally:
+        stop.set()
+        gw.shutdown()
+        gw.server_close()
+        ctrl.controller.shutdown()
+        kubelet_mod.LOG_FLUSH_SECONDS = flush0
+        sc_mod.AUTOSCALE_PERIOD_S = period0
+
+
 def _gen_serving_probe(small: bool, full: bool = False):
     """Generative serving throughput (ISSUE 7): the continuous-batching
     decode loop (runtime/server.DecodeLoopExecutor — token-granularity
@@ -1367,6 +1591,18 @@ def main() -> None:
             print(f"bench: gen serving probe failed: {exc}", file=sys.stderr)
             degraded.append("gen_serving")
 
+    # -- gateway front door: the serving sweep through the wire plus the
+    # multi-tenant fairness round (hermetic: real sockets, fake cluster) -
+    gateway_block = None
+    if os.environ.get("BENCH_GATEWAY", "1") == "1":
+        try:
+            gateway_block = _gateway_probe(
+                small, full=os.environ.get("BENCH_GATEWAY_FULL") == "1"
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: gateway probe failed: {exc}", file=sys.stderr)
+            degraded.append("gateway")
+
     # -- elastic recovery: reclaim-notice -> resized-gang-training time
     # against the real controller + kubelet (hermetic, chip-free) --------
     recovery_block = None
@@ -1579,6 +1815,7 @@ def main() -> None:
                         {"gen_serving": gen_serving_block}
                         if gen_serving_block else {}
                     ),
+                    **({"gateway": gateway_block} if gateway_block else {}),
                     **({"recovery": recovery_block} if recovery_block else {}),
                     **(
                         {
@@ -1644,7 +1881,7 @@ def main() -> None:
     print(
         build_headline(
             detail, image_block, detail_name, serving_block, recovery_block,
-            gen_serving_block,
+            gen_serving_block, gateway_block,
         )
     )
 
@@ -1658,7 +1895,7 @@ HEADLINE_MAX_CHARS = 1800
 
 def build_headline(
     detail: dict, image_block, detail_name, serving_block=None,
-    recovery_block=None, gen_serving_block=None,
+    recovery_block=None, gen_serving_block=None, gateway_block=None,
 ) -> str:
     """Assemble the final-stdout headline line from the full detail
     record: the fixed key set, the image-decode and serving rows when
@@ -1740,6 +1977,23 @@ def build_headline(
                 if k in gen_serving_block
             }
         )
+    if gateway_block:
+        # the gateway rows ride the headline: wire QPS at the best sweep
+        # point, its p99, the wire/in-process efficiency, and the
+        # multi-tenant fairness ratio — the driver's acceptance keys for
+        # the front-door arm
+        headline_extra.update(
+            {
+                k: gateway_block[k]
+                for k in (
+                    "gateway_qps",
+                    "gateway_p99_ms",
+                    "gateway_wire_efficiency",
+                    "gateway_fairness_ratio",
+                )
+                if k in gateway_block
+            }
+        )
     if recovery_block:
         # the elastic-recovery rows ride the headline: seconds from a
         # reclaim notice to the RESIZED gang's first post-resize optimizer
@@ -1771,10 +2025,12 @@ def build_headline(
         "serving_model", "serving_p50_ms", "serving_batch_occupancy",
         "recovery_backoff_burned",
         "gen_tokens_per_s_baseline", "gen_speedup_vs_batch",
+        "gateway_wire_efficiency", "gateway_p99_ms",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
         "image_meets_budget", "img_per_sec_native",
         "serving_p99_ms", "serving_qps",
+        "gateway_fairness_ratio", "gateway_qps",
         "tpot_p99_ms", "gen_tokens_per_s",
         "recovery_p99_s", "recovery_p50_s",
         "image_decode_images_per_sec", "bert_base_mlm_step_time_ms",

@@ -91,6 +91,34 @@ FULL_GEN_SERVING_BLOCK = {
 }
 
 
+FULL_GATEWAY_BLOCK = {
+    "gateway_model": "echo",
+    "gateway_replicas": 2,
+    "gateway_echo_delay_ms": 1.0,
+    "gateway_sweep": [
+        {"offered_qps": 250, "achieved_qps": 249.9, "p50_ms": 4.1,
+         "p99_ms": 9.2, "shed": 0},
+        {"offered_qps": 4000, "achieved_qps": 3320.5, "p50_ms": 21.3,
+         "p99_ms": 88.0, "shed": 104},
+    ],
+    "gateway_inprocess_sweep": [
+        {"offered_qps": 4000, "achieved_qps": 3911.0, "p50_ms": 14.0,
+         "p99_ms": 60.2, "shed": 12},
+    ],
+    "gateway_qps": 3320.5,
+    "gateway_p50_ms": 21.3,
+    "gateway_p99_ms": 88.0,
+    "gateway_inprocess_qps": 3911.0,
+    "gateway_wire_efficiency": 0.849,
+    "gateway_fairness_ratio": 0.981,
+    "gateway_served_good_alone": 200,
+    "gateway_served_good_with_abuser": 196,
+    "gateway_abuser_served": 21,
+    "gateway_shed_typed": 104,
+    "gateway_shed_untyped": 0,
+}
+
+
 FULL_RECOVERY_BLOCK = {
     "recovery_workers": 4,
     "recovery_min_replicas": 2,
@@ -109,6 +137,7 @@ def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
         FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
+        FULL_GATEWAY_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -121,6 +150,8 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert "serving_sweep" not in parsed["extra"]
     assert "recovery_samples_s" not in parsed["extra"]
     assert "gen_useful_tokens" not in parsed["extra"]
+    assert "gateway_sweep" not in parsed["extra"]
+    assert "gateway_shed_typed" not in parsed["extra"]
     # the driver's acceptance keys survive at normal sizes
     assert parsed["extra"]["img_per_sec_native"] == 1030.1
     assert parsed["extra"]["serving_qps"] == 2310.4
@@ -134,6 +165,11 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert parsed["extra"]["tpot_p99_ms"] == 210.7
     assert parsed["extra"]["gen_speedup_vs_batch"] == 2.7
     assert parsed["extra"]["gen_tokens_per_s_baseline"] == 456.7
+    # ISSUE-10 gateway acceptance keys
+    assert parsed["extra"]["gateway_qps"] == 3320.5
+    assert parsed["extra"]["gateway_p99_ms"] == 88.0
+    assert parsed["extra"]["gateway_wire_efficiency"] == 0.849
+    assert parsed["extra"]["gateway_fairness_ratio"] == 0.981
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -143,7 +179,7 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     fat["degraded_sections"] = [f"section_{i:03d}" for i in range(60)]
     line = bench.build_headline(
         _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
-        FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
+        FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK, FULL_GATEWAY_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -160,6 +196,7 @@ def test_headline_without_image_block():
     assert "serving_qps" not in parsed["extra"]
     assert "recovery_p50_s" not in parsed["extra"]
     assert "gen_tokens_per_s" not in parsed["extra"]
+    assert "gateway_qps" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
@@ -175,5 +212,7 @@ def test_serving_keys_in_drop_order():
                 "recovery_p50_s", "recovery_p99_s",
                 "recovery_backoff_burned",
                 "gen_tokens_per_s", "tpot_p99_ms",
-                "gen_speedup_vs_batch", "gen_tokens_per_s_baseline"):
+                "gen_speedup_vs_batch", "gen_tokens_per_s_baseline",
+                "gateway_qps", "gateway_p99_ms",
+                "gateway_wire_efficiency", "gateway_fairness_ratio"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

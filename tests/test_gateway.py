@@ -1,0 +1,337 @@
+"""Gateway unit tests (ISSUE-10): least-queue-depth routing, per-tenant
+admission (quota + concurrency + priority shed bands), and the shed
+backoff contract on both clients — shed traffic honors Retry-After with
+jittered backoff instead of re-hammering."""
+
+import json
+
+import pytest
+
+import tfk8s_tpu.gateway.client as gw_client_mod
+import tfk8s_tpu.runtime.server as server_mod
+from tfk8s_tpu.api.types import TenantPolicy, TenantQuota
+from tfk8s_tpu.client.ratelimit import TokenBucketRateLimiter
+from tfk8s_tpu.client.store import NotFound
+from tfk8s_tpu.gateway.admission import TenantAdmission, shed_threshold
+from tfk8s_tpu.gateway.client import GatewayClient, _map_error
+from tfk8s_tpu.gateway.router import RouteTable
+from tfk8s_tpu.runtime.server import (
+    DeadlineExceeded,
+    Overloaded,
+    QuotaExceeded,
+    ServeClient,
+    jittered_backoff,
+)
+
+
+class FakeClock:
+    def __init__(self, now=100.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+# ---------------------------------------------------------------------------
+# RouteTable
+# ---------------------------------------------------------------------------
+
+
+class TestRouteTable:
+    def table(self, **kw):
+        return RouteTable(clientset=None, name="s", **kw)
+
+    def test_pick_least_depth_under_skew(self):
+        t = self.table()
+        t.observe("default/p-a", 10.0)
+        t.observe("default/p-b", 2.0)
+        t.observe("default/p-c", 5.0)
+        assert t.pick() == "default/p-b"
+
+    def test_inflight_correction_spreads_a_burst(self):
+        # all replicas publish the same depth: without the local
+        # in-flight correction every pick between kubelet flushes would
+        # land on the same (sorted-first) replica
+        t = self.table()
+        for key in ("default/p-a", "default/p-b", "default/p-c"):
+            t.observe(key, 1.0)
+        picks = [t.pick() for _ in range(6)]
+        assert sorted(picks) == [
+            "default/p-a", "default/p-a",
+            "default/p-b", "default/p-b",
+            "default/p-c", "default/p-c",
+        ]
+
+    def test_release_returns_the_slot(self):
+        t = self.table()
+        t.observe("default/p-a", 0.0)
+        t.observe("default/p-b", 0.5)
+        assert t.pick() == "default/p-a"   # now effectively 1.0
+        assert t.pick() == "default/p-b"   # 0.5 < 1.0
+        t.release("default/p-a")
+        assert t.pick() == "default/p-a"   # slot returned: 0.0 again... < 1.5
+
+    def test_stale_entries_age_out(self):
+        clock = FakeClock()
+        t = self.table(clock=clock, stale_after_s=3.0)
+        t.observe("default/p-old", 0.0)
+        clock.advance(2.0)
+        t.observe("default/p-new", 5.0)
+        clock.advance(2.0)  # old last seen 4s ago, new 2s ago
+        assert t.pick() == "default/p-new"
+        assert [k for k, _ in t.targets()] == ["default/p-new"]
+        clock.advance(2.0)  # everything stale now
+        assert t.pick() is None
+        assert t.least_depth() == float("inf")
+
+    def test_draining_replica_leaves_the_route_table(self):
+        t = self.table()
+        t.observe("default/p-a", 0.0)
+        t.observe("default/p-b", 5.0)
+        t.mark_draining("default/p-a")
+        assert t.pick() == "default/p-b"
+        # late depth reports for a draining replica are ignored
+        t.observe("default/p-a", 0.0)
+        assert [k for k, _ in t.targets()] == ["default/p-b"]
+
+    def test_observe_smooths_with_ema(self):
+        from tfk8s_tpu.trainer.serve_controller import EMA_ALPHA
+
+        t = self.table()
+        t.observe("default/p-a", 10.0)
+        t.observe("default/p-a", 0.0)
+        (key, depth), = t.targets()
+        assert key == "default/p-a"
+        assert depth == pytest.approx((1 - EMA_ALPHA) * 10.0)
+
+    def test_exclude_skips_replicas(self):
+        t = self.table()
+        t.observe("default/p-a", 0.0)
+        t.observe("default/p-b", 9.0)
+        assert t.pick(exclude={"default/p-a"}) == "default/p-b"
+        assert t.pick(exclude={"default/p-a", "default/p-b"}) is None
+
+
+# ---------------------------------------------------------------------------
+# TenantAdmission
+# ---------------------------------------------------------------------------
+
+
+def policy(tenants=None, default=None, enabled=True):
+    return TenantPolicy(
+        enabled=enabled,
+        tenants=tenants or {},
+        default_quota=default or TenantQuota(qps=0.0),
+    )
+
+
+class TestShedThreshold:
+    def test_bands(self):
+        assert shed_threshold(0) == 0.5
+        assert shed_threshold(1) == 0.75
+        assert shed_threshold(2) == 1.0
+        assert shed_threshold(7) == 1.0   # clamped
+        assert shed_threshold(-3) == 0.5  # negative treated as lowest
+
+
+class TestTenantAdmission:
+    def test_disabled_policy_admits_everything(self):
+        adm = TenantAdmission()
+        adm.configure(policy(enabled=False))
+        for _ in range(100):
+            adm.admit("anyone", depth=1e9, limit=1)()
+
+    def test_qps_quota_sheds_typed_with_retry_after(self):
+        adm = TenantAdmission()
+        adm.configure(policy({"t": TenantQuota(qps=1.0, burst=1)}))
+        adm.admit("t", depth=0, limit=64)()
+        with pytest.raises(QuotaExceeded) as ei:
+            adm.admit("t", depth=0, limit=64)
+        assert ei.value.tenant == "t"
+        assert ei.value.reason == "qps"
+        assert 0 < ei.value.retry_after_s <= 1.0
+
+    def test_concurrency_quota_releases(self):
+        adm = TenantAdmission()
+        adm.configure(policy({"t": TenantQuota(qps=0.0, max_concurrency=1)}))
+        release = adm.admit("t", depth=0, limit=64)
+        with pytest.raises(QuotaExceeded) as ei:
+            adm.admit("t", depth=0, limit=64)
+        assert ei.value.reason == "concurrency"
+        release()
+        adm.admit("t", depth=0, limit=64)()  # slot freed
+
+    def test_priority_bands_shed_low_first(self):
+        adm = TenantAdmission()
+        adm.configure(policy({
+            "lo": TenantQuota(qps=0.0, priority=0),
+            "mid": TenantQuota(qps=0.0, priority=1),
+            "hi": TenantQuota(qps=0.0, priority=2),
+        }))
+        limit = 100
+        # half full: only the lowest band sheds
+        with pytest.raises(Overloaded) as ei:
+            adm.admit("lo", depth=50, limit=limit)
+        assert ei.value.shed_reason == "priority"
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        adm.admit("mid", depth=50, limit=limit)()
+        adm.admit("hi", depth=50, limit=limit)()
+        # three quarters: mid sheds too, hi survives
+        with pytest.raises(Overloaded):
+            adm.admit("mid", depth=75, limit=limit)
+        adm.admit("hi", depth=75, limit=limit)()
+        # full: everyone sheds
+        with pytest.raises(Overloaded):
+            adm.admit("hi", depth=100, limit=limit)
+
+    def test_unknown_tenant_gets_the_default_quota(self):
+        adm = TenantAdmission()
+        adm.configure(policy(default=TenantQuota(qps=1.0, burst=1)))
+        adm.admit("stranger", depth=0, limit=64)()
+        with pytest.raises(QuotaExceeded):
+            adm.admit("stranger", depth=0, limit=64)
+
+    def test_reconfigure_preserves_unchanged_buckets(self):
+        # a policy edit elsewhere must NOT hand this tenant a fresh burst
+        adm = TenantAdmission()
+        adm.configure(policy({"t": TenantQuota(qps=1.0, burst=1)}))
+        adm.admit("t", depth=0, limit=64)()  # burst spent
+        adm.configure(policy({
+            "t": TenantQuota(qps=1.0, burst=1),
+            "other": TenantQuota(qps=5.0, burst=5),
+        }))
+        with pytest.raises(QuotaExceeded):
+            adm.admit("t", depth=0, limit=64)
+        # a CHANGED quota does rebuild the bucket (new burst available)
+        adm.configure(policy({"t": TenantQuota(qps=10.0, burst=10)}))
+        adm.admit("t", depth=0, limit=64)()
+
+
+# ---------------------------------------------------------------------------
+# Shed backoff: both clients honor Retry-After with jitter
+# ---------------------------------------------------------------------------
+
+
+class TestJitteredBackoff:
+    def test_hint_drives_the_range(self):
+        for _ in range(50):
+            assert 0.1 <= jittered_backoff(0.2, 5.0) < 0.3 + 1e-9
+
+    def test_fallback_when_no_hint(self):
+        for _ in range(50):
+            assert 0.025 <= jittered_backoff(None, 0.05) < 0.075 + 1e-9
+            assert 0.025 <= jittered_backoff(0.0, 0.05) < 0.075 + 1e-9
+
+    def test_bucket_delay_is_the_retry_after(self):
+        clock = FakeClock()
+        b = TokenBucketRateLimiter(qps=2.0, burst=1, clock=clock)
+        assert b.try_accept_or_delay() == 0.0
+        delay = b.try_accept_or_delay()
+        assert delay == pytest.approx(0.5)  # 1 token / 2 qps
+        clock.advance(delay)
+        assert b.try_accept_or_delay() == 0.0
+
+
+class _TimeShim:
+    """time-module stand-in that records sleeps instead of sleeping."""
+
+    def __init__(self, real):
+        self._real = real
+        self.sleeps = []
+
+    def monotonic(self):
+        return self._real.monotonic()
+
+    def perf_counter(self):
+        return self._real.perf_counter()
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+
+
+class _SheddingReplica:
+    def __init__(self, sheds):
+        self.sheds = sheds
+        self.calls = 0
+
+    def submit(self, payload, timeout=None):
+        self.calls += 1
+        if self.calls <= self.sheds:
+            raise Overloaded(10, 10, retry_after_s=0.2)
+        return {"ok": payload}
+
+
+class TestServeClientShedBackoff:
+    def test_shed_traffic_backs_off_before_retrying(self, monkeypatch):
+        replica = _SheddingReplica(sheds=2)
+        shim = _TimeShim(server_mod.time)
+        monkeypatch.setattr(server_mod, "time", shim)
+        monkeypatch.setattr(server_mod, "lookup_replica", lambda key: replica)
+        monkeypatch.setattr(
+            ServeClient, "ready_replica_keys",
+            lambda self, refresh=False: ["default/p-0"],
+        )
+        client = ServeClient(None, "s")
+        assert client.request(1.0, timeout=5) == {"ok": 1.0}
+        assert replica.calls == 3
+        # one jittered backoff per shed, in the hint's [0.5x, 1.5x) band
+        assert len(shim.sleeps) == 2
+        assert all(0.1 <= s < 0.3 + 1e-9 for s in shim.sleeps)
+
+    def test_shed_surfaces_when_deadline_cannot_absorb_backoff(self, monkeypatch):
+        replica = _SheddingReplica(sheds=10**6)
+        monkeypatch.setattr(server_mod, "lookup_replica", lambda key: replica)
+        monkeypatch.setattr(
+            ServeClient, "ready_replica_keys",
+            lambda self, refresh=False: ["default/p-0"],
+        )
+        client = ServeClient(None, "s")
+        with pytest.raises((Overloaded, DeadlineExceeded)):
+            client.request(1.0, timeout=0.05)
+
+
+def _envelope(reason, **details):
+    return json.dumps({
+        "kind": "Status", "status": "Failure", "reason": reason,
+        "message": reason, "details": details,
+    }).encode()
+
+
+class TestGatewayClientShedBackoff:
+    def test_429_retries_after_jittered_backoff(self, monkeypatch):
+        shim = _TimeShim(gw_client_mod.time)
+        monkeypatch.setattr(gw_client_mod, "time", shim)
+        responses = [
+            (429, {"Retry-After": "0.200"},
+             _envelope("Overloaded", queueDepth=9, queueLimit=10)),
+            (429, {"Retry-After": "0.200"},
+             _envelope("QuotaExceeded", tenant="t", quota="qps",
+                       retryAfterS=0.2)),
+            (200, {}, json.dumps({"result": {"version": "v1"}}).encode()),
+        ]
+        monkeypatch.setattr(
+            GatewayClient, "_roundtrip", lambda self, body: responses.pop(0)
+        )
+        client = GatewayClient("http://127.0.0.1:1", "s", tenant="t")
+        assert client.request(1.0, timeout=5) == {"version": "v1"}
+        assert not responses  # all three roundtrips consumed
+        assert len(shim.sleeps) == 2
+        assert all(0.1 <= s < 0.3 + 1e-9 for s in shim.sleeps)
+
+    def test_wire_errors_rematerialize_typed(self):
+        err = _map_error(429, "QuotaExceeded", "m",
+                         {"tenant": "t", "quota": "concurrency"}, 0.3)
+        assert isinstance(err, QuotaExceeded)
+        assert (err.tenant, err.reason) == ("t", "concurrency")
+        assert err.retry_after_s == 0.3
+        err = _map_error(429, "Overloaded", "m",
+                         {"queueDepth": 7, "queueLimit": 8}, 0.1)
+        assert isinstance(err, Overloaded)
+        assert (err.queue_depth, err.queue_limit) == (7, 8)
+        assert isinstance(_map_error(404, "NotFound", "m", {}, None), NotFound)
+        assert isinstance(
+            _map_error(504, "DeadlineExceeded", "m", {}, None), DeadlineExceeded
+        )

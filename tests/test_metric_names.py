@@ -55,6 +55,14 @@ def test_default_scope_covers_hotpath_counters():
         "tfk8s_serving_slot_occupancy": False,
         "tfk8s_serving_page_occupancy": False,
         "tfk8s_serving_prefix_cache_hits_total": False,
+        # ISSUE-10 gateway series: the gateway bench arm, the fairness
+        # round, and the route-table tests key off these exact names
+        "tfk8s_gateway_request_seconds": False,
+        "tfk8s_gateway_queue_seconds": False,
+        "tfk8s_gateway_shed_total": False,
+        "tfk8s_gateway_requests_total": False,
+        "tfk8s_gateway_route_replicas": False,
+        "tfk8s_gateway_route_depth": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

@@ -10,6 +10,8 @@ defaults (mesh = pure data-parallel over all chips) are new surface.
 
 from __future__ import annotations
 
+import math
+
 from tfk8s_tpu.api.types import (
     CleanPodPolicy,
     MeshSpec,
@@ -99,4 +101,11 @@ def set_serve_defaults(serve: TPUServe) -> TPUServe:
         # the autoscaler owns replicas between its bounds; a spec count
         # outside them is clamped rather than rejected (HPA semantics)
         spec.replicas = min(max(spec.replicas, auto.min_replicas), auto.max_replicas)
+    ten = spec.tenancy
+    if ten.enabled:
+        # burst=0 means "one second's worth of tokens, at least 1" — the
+        # smallest bucket that still admits a full-rate steady stream
+        for quota in [ten.default_quota, *ten.tenants.values()]:
+            if quota.burst == 0 and quota.qps > 0:
+                quota.burst = max(1, math.ceil(quota.qps))
     return serve

@@ -388,6 +388,37 @@ class AutoscalePolicy:
 
 
 @dataclass
+class TenantQuota:
+    """One tenant's admission budget at the gateway (gateway/admission.py).
+    ``qps``/``burst`` parameterize a reservation-style token bucket
+    (client/ratelimit.py); ``max_concurrency`` caps in-flight requests
+    (0 = unlimited); ``priority`` orders tenants under overload — when the
+    target replica set saturates, LOWER priorities shed first."""
+
+    qps: float = 100.0
+    # bucket capacity in requests; 0 defaults to max(1, ceil(qps))
+    burst: int = 0
+    max_concurrency: int = 0
+    priority: int = 0
+
+
+@dataclass
+class TenantPolicy:
+    """Multi-tenant admission at the serving front door. Tenants are
+    identified by the request's ``X-Tenant`` header (map keys are data and
+    pass through the wire verbatim, like labels); a tenant absent from
+    ``tenants`` gets its OWN bucket sized by ``default_quota``. Disabled
+    (the default) the gateway admits everything and only the replicas'
+    bounded queues shed. Quota edits deliberately do NOT change the
+    pod-template hash — tightening a tenant must never roll the serving
+    pods."""
+
+    enabled: bool = False
+    tenants: Dict[str, TenantQuota] = field(default_factory=dict)
+    default_quota: TenantQuota = field(default_factory=TenantQuota)
+
+
+@dataclass
 class TPUServeSpec:
     """What to serve and how. ``task`` names a registered served-model
     family (runtime/server.py: ``echo`` / ``mlp`` / ``gpt``);
@@ -406,6 +437,8 @@ class TPUServeSpec:
     batching: BatchingPolicy = field(default_factory=BatchingPolicy)
     rolling_update: RollingUpdatePolicy = field(default_factory=RollingUpdatePolicy)
     autoscale: AutoscalePolicy = field(default_factory=AutoscalePolicy)
+    # gateway admission only — excluded from the pod-template hash
+    tenancy: TenantPolicy = field(default_factory=TenantPolicy)
     tpu: TPUSpec = field(default_factory=TPUSpec)
 
 
@@ -425,6 +458,8 @@ class TPUServeStatus:
     queue_depth: float = 0.0
     qps: float = 0.0
     last_scale_time: Optional[float] = field(default=None, metadata=RFC3339)
+    # gateway route for this serve (path under the gateway's base URL)
+    endpoint: str = ""
 
 
 @dataclass

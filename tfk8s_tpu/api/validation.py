@@ -254,6 +254,26 @@ def validate_serve(serve: TPUServe) -> List[str]:
         if a.cooldown_s < 0:
             errs.append(f"spec.autoscale.cooldownS: must be >= 0, got {a.cooldown_s}")
 
+    ten = spec.tenancy
+    if ten.enabled:
+        for path, quota in [
+            ("spec.tenancy.defaultQuota", ten.default_quota),
+            *((f"spec.tenancy.tenants[{name!r}]", q)
+              for name, q in sorted(ten.tenants.items())),
+        ]:
+            if quota.qps < 0:
+                errs.append(f"{path}.qps: must be >= 0, got {quota.qps}")
+            if quota.burst < 0:
+                errs.append(f"{path}.burst: must be >= 0, got {quota.burst}")
+            if quota.max_concurrency < 0:
+                errs.append(
+                    f"{path}.maxConcurrency: must be >= 0, got "
+                    f"{quota.max_concurrency}"
+                )
+        for name in sorted(ten.tenants):
+            if not name:
+                errs.append("spec.tenancy.tenants: tenant name cannot be empty")
+
     if spec.tpu.accelerator:
         try:
             topo.parse_accelerator(spec.tpu.accelerator, spec.tpu.topology)

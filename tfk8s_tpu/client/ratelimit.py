@@ -50,6 +50,18 @@ class TokenBucketRateLimiter:
                 return True
             return False
 
+    def try_accept_or_delay(self) -> float:
+        """Admission-control shape: debit and return 0.0 when a token is
+        available, else return (WITHOUT debiting or blocking) the seconds
+        until one accrues — the Retry-After a shedding gateway puts on
+        the 429 so clients back off for exactly the bucket's debt."""
+        with self._lock:
+            self._refill()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            return (1.0 - self._tokens) / self.qps
+
     def accept(self, n: int = 1) -> None:
         if n <= 0:
             return

@@ -47,6 +47,8 @@ class Options:
     # observability endpoint (/metrics, /healthz, /events, /traces);
     # 0 = disabled
     metrics_port: int = 0
+    # inference front door (POST /v1/serve/<ns>/<name>); 0 = disabled
+    gateway_port: int = 0
     # logging
     log_level: str = "info"
 
@@ -91,6 +93,9 @@ class Options:
         g.add_argument("--metrics-port", type=int, default=0, dest="metrics_port",
                        help="serve /metrics, /healthz, /events, /traces "
                             "on this port (0=off)")
+        g.add_argument("--gateway-port", type=int, default=0, dest="gateway_port",
+                       help="serve the inference front door (POST "
+                            "/v1/serve/<ns>/<name>) on this port (0=off)")
         g.add_argument("--log-level", default="info",
                        choices=["debug", "info", "warning", "error"])
 
@@ -113,6 +118,7 @@ class Options:
             local_kubelet=args.local_kubelet,
             kubeconfig=getattr(args, "kubeconfig", ""),
             metrics_port=args.metrics_port,
+            gateway_port=getattr(args, "gateway_port", 0),
             log_level=args.log_level,
         )
 

@@ -99,6 +99,7 @@ class Server:
         self.kubelet = LocalKubelet(self.clientset) if opts.local_kubelet else None
         self._threads: list = []
         self._http: Optional[http.server.ThreadingHTTPServer] = None
+        self.gateway = None  # started in run() when opts.gateway_port
 
     # -- observability endpoint (SURVEY.md §5: absent in the reference;
     #    /metrics Prometheus text, /healthz, /events JSON, /traces JSON) --
@@ -179,6 +180,19 @@ class Server:
         if self.opts.metrics_port:
             port = self.start_metrics_server(self.opts.metrics_port)
             log.info("metrics endpoint on 127.0.0.1:%d", port)
+        if self.opts.gateway_port:
+            # the serving front door rides the leader-independent plane
+            # (like the kubelet): it routes to whatever replicas exist,
+            # regardless of which operator process reconciles them
+            from tfk8s_tpu.gateway.server import GatewayServer
+
+            self.gateway = GatewayServer(
+                self.clientset,
+                port=self.opts.gateway_port,
+                metrics=self.metrics,
+            )
+            gw_port = self.gateway.serve_background()
+            log.info("gateway front door on 127.0.0.1:%d", gw_port)
         if self.kubelet:
             self.kubelet.run(stop)  # informer-driven; returns immediately
 
@@ -219,5 +233,7 @@ class Server:
     def shutdown(self) -> None:
         if self._http is not None:
             self._http.shutdown()
+        if self.gateway is not None:
+            self.gateway.shutdown()
         self.controller.controller.shutdown()
         self.serve_controller.controller.shutdown()

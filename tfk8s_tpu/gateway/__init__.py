@@ -1,0 +1,22 @@
+"""TPUGateway: the HTTP serving front door (ISSUE 10 / ROADMAP item 3).
+
+One wire entrance for inference traffic: an HTTP transport on the
+apiserver's proven stack (:mod:`tfk8s_tpu.gateway.server`), least-queue-
+depth routing over the replicas' published load signal
+(:mod:`tfk8s_tpu.gateway.router`), and per-tenant token-bucket admission
+with priority shedding (:mod:`tfk8s_tpu.gateway.admission`). The thin
+pipelined client lives in :mod:`tfk8s_tpu.gateway.client`.
+"""
+
+from tfk8s_tpu.gateway.admission import TenantAdmission, shed_threshold
+from tfk8s_tpu.gateway.client import GatewayClient
+from tfk8s_tpu.gateway.router import RouteTable
+from tfk8s_tpu.gateway.server import GatewayServer
+
+__all__ = [
+    "GatewayClient",
+    "GatewayServer",
+    "RouteTable",
+    "TenantAdmission",
+    "shed_threshold",
+]

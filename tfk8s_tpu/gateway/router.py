@@ -1,0 +1,215 @@
+"""Least-queue-depth routing for the serving front door.
+
+Replaces the client-side round-robin-over-Ready with load-aware
+selection fed by the signal the replicas ALREADY publish: each model
+server reports ``serving_queue_depth`` through ``runtime/progress.py``
+→ kubelet flush → ``pod.status.training`` — the channel the autoscaler
+consumes. The route table EMA-smooths the per-replica depth with the
+autoscaler's own alpha (one smoothing constant, two consumers — the two
+views of "how loaded is this replica" can never disagree on dynamics)
+and corrects for publication lag by adding the requests IT has in
+flight to each replica (least-outstanding-requests on top of the
+published base, so a burst between kubelet flushes doesn't pile onto
+the momentarily-least-loaded replica).
+
+Replica lifecycle in the table:
+
+- **discovery**: a clientset pod list (label selector, TTL-cached like
+  ``ServeClient``) admits Ready replicas and refreshes their depth;
+- **stale aging**: an entry not re-observed within ``stale_after_s``
+  (vanished pod, wedged kubelet) silently leaves the routing set;
+- **draining**: ``mark_draining`` removes a replica the instant its
+  drain starts — the gateway subscribes to
+  ``runtime.server.add_drain_hook``, which fires when the replica
+  unregisters, BEFORE the kubelet would publish anything — preserving
+  the zero-failed-request rollout contract on the wire path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from tfk8s_tpu.trainer.serve_controller import EMA_ALPHA
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("gateway.router")
+
+# an entry not re-observed within this window is presumed vanished
+STALE_AFTER_S = 3.0
+# discovery refresh cadence (matches ServeClient's endpoint cache TTL)
+CACHE_TTL_S = 0.25
+
+
+class _Entry:
+    __slots__ = ("depth", "seen")
+
+    def __init__(self, depth: float, seen: float):
+        self.depth = depth
+        self.seen = seen
+
+
+class RouteTable:
+    """Load-aware route table for ONE TPUServe. ``pick`` returns the
+    least-loaded routable replica key and leases an in-flight slot on
+    it; ``release`` returns the slot when the dispatch finishes either
+    way. ``clientset=None`` disables discovery — unit tests (and any
+    out-of-band feed) drive the table through ``observe`` directly."""
+
+    def __init__(
+        self,
+        clientset=None,
+        name: str = "",
+        namespace: str = "default",
+        cache_ttl_s: float = CACHE_TTL_S,
+        stale_after_s: float = STALE_AFTER_S,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        self._cs = clientset
+        self.name = name
+        self.namespace = namespace
+        self._cache_ttl = cache_ttl_s
+        self._stale_after = stale_after_s
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self._inflight: Dict[str, int] = {}
+        # key -> when the drain was observed (purged once stale: by then
+        # the pod is gone from every discovery source)
+        self._draining: Dict[str, float] = {}
+        self._last_refresh = 0.0
+
+    # -- feeds ---------------------------------------------------------------
+
+    def observe(self, key: str, depth: float) -> None:
+        """Fold one published depth sample into the table (EMA-smoothed,
+        the autoscaler's alpha)."""
+        now = self._clock()
+        with self._lock:
+            if key in self._draining:
+                return
+            e = self._entries.get(key)
+            if e is None:
+                self._entries[key] = _Entry(float(depth), now)
+            else:
+                e.depth = EMA_ALPHA * float(depth) + (1 - EMA_ALPHA) * e.depth
+                e.seen = now
+
+    def mark_draining(self, key: str) -> None:
+        """Remove a replica from the routing set at drain START (the
+        in-process drain hook) — requests already dispatched to it finish
+        (the replica drains its queue); nothing new routes to it."""
+        now = self._clock()
+        with self._lock:
+            if key not in self._entries and key not in self._draining:
+                return
+            self._entries.pop(key, None)
+            self._draining[key] = now
+        log.debug("%s/%s: %s draining; removed from route table",
+                  self.namespace, self.name, key)
+
+    def refresh(self, force: bool = False) -> None:
+        """Re-discover Ready replicas and their published depths through
+        the clientset (no-op within the TTL, or with no clientset)."""
+        if self._cs is None:
+            return
+        now = self._clock()
+        with self._lock:
+            if not force and now - self._last_refresh < self._cache_ttl:
+                return
+            self._last_refresh = now
+        # the list (rate-limited client call) runs OUTSIDE the table lock
+        from tfk8s_tpu.runtime.server import replica_is_ready
+        from tfk8s_tpu.trainer import labels as L
+
+        pods, _rv = self._cs.pods(self.namespace).list(
+            label_selector=L.serve_selector(self.name)
+        )
+        for p in pods:
+            if replica_is_ready(p):
+                self.observe(
+                    p.metadata.key,
+                    float(p.status.training.get("serving_queue_depth", 0.0)),
+                )
+        self._publish_gauges()
+
+    # -- routing -------------------------------------------------------------
+
+    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
+        """Least effective depth (published EMA + local in-flight) among
+        fresh, non-draining, non-excluded replicas; leases an in-flight
+        slot on the winner. None when nothing is routable."""
+        self.refresh()
+        now = self._clock()
+        with self._lock:
+            self._purge_locked(now)
+            best: Optional[str] = None
+            best_depth = 0.0
+            for key in sorted(self._entries):  # sorted: deterministic ties
+                if exclude and key in exclude:
+                    continue
+                d = self._entries[key].depth + self._inflight.get(key, 0)
+                if best is None or d < best_depth:
+                    best, best_depth = key, d
+            if best is not None:
+                self._inflight[best] = self._inflight.get(best, 0) + 1
+            return best
+
+    def release(self, key: str) -> None:
+        with self._lock:
+            n = self._inflight.get(key, 0)
+            if n <= 1:
+                self._inflight.pop(key, None)
+            else:
+                self._inflight[key] = n - 1
+
+    def least_depth(self) -> float:
+        """The least effective depth across routable replicas (inf when
+        none) — the admission layer's cluster-pressure signal."""
+        self.refresh()
+        now = self._clock()
+        with self._lock:
+            self._purge_locked(now)
+            depths = [
+                e.depth + self._inflight.get(k, 0)
+                for k, e in self._entries.items()
+            ]
+        return min(depths) if depths else float("inf")
+
+    def targets(self) -> List[Tuple[str, float]]:
+        """Routable (key, effective depth) pairs — debug/test surface."""
+        now = self._clock()
+        with self._lock:
+            self._purge_locked(now)
+            return sorted(
+                (k, e.depth + self._inflight.get(k, 0))
+                for k, e in self._entries.items()
+            )
+
+    # -- internals -----------------------------------------------------------
+
+    def _purge_locked(self, now: float) -> None:
+        for key, e in list(self._entries.items()):
+            if now - e.seen > self._stale_after:
+                del self._entries[key]
+                log.debug("%s/%s: %s aged out of route table",
+                          self.namespace, self.name, key)
+        for key, when in list(self._draining.items()):
+            if now - when > self._stale_after:
+                del self._draining[key]
+
+    def _publish_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        rows = self.targets()  # takes the lock itself; gauges set outside
+        labels = {"serve": f"{self.namespace}/{self.name}"}
+        self._metrics.set_gauge(
+            "tfk8s_gateway_route_replicas", float(len(rows)), labels
+        )
+        self._metrics.set_gauge(
+            "tfk8s_gateway_route_depth",
+            min((d for _, d in rows), default=0.0), labels,
+        )

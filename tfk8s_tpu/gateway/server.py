@@ -1,0 +1,446 @@
+"""TPUGateway HTTP server: the one wire entrance for inference traffic.
+
+Same transport stack and idioms as ``client/apiserver.py`` (threaded
+``http.server``, HTTP/1.1 keep-alive, per-request latency histograms,
+``metav1.Status`` error envelopes) serving one route::
+
+    POST /v1/serve/<namespace>/<name>
+        body:    {"payload": <JSON payload>, "timeoutS": <float, opt>}
+        headers: X-Tenant: <tenant id>        (default "default")
+        200:     {"result": <model response>}
+
+Status-code matrix (every error is typed — the ServeError taxonomy on
+the wire; shed responses ALWAYS carry Retry-After)::
+
+    400 InvalidRequest    unservable request (never retried)
+    404 NotFound          no such TPUServe
+    429 QuotaExceeded     the TENANT's bucket/concurrency budget
+    429 Overloaded        cluster pressure (priority shed or replica queue)
+    500 RequestFailed     model raised executing the batch
+    503 Unavailable       no routable replica held until the deadline
+    504 DeadlineExceeded  deadline elapsed while queued/executing
+
+``Retry-After`` uses fractional seconds (e.g. ``0.087``): sub-second
+backoff is the natural timescale of a batching queue and this is our
+own client on both ends; integer-second rounding would quantize every
+backoff to >= 1 s and idle the fleet. The Draining replicas a rollout
+produces are never surfaced: the router drops them at drain start (the
+in-process drain hook) and the dispatch loop retries the next-least-
+loaded replica inside the caller's deadline — the wire keeps the
+zero-failed-request contract the in-process client already had.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from tfk8s_tpu.client.store import NotFound, Unavailable
+from tfk8s_tpu.gateway.admission import TenantAdmission
+from tfk8s_tpu.gateway.router import RouteTable
+from tfk8s_tpu.runtime import server as serving
+from tfk8s_tpu.runtime.server import (
+    DeadlineExceeded,
+    Draining,
+    InvalidRequest,
+    Overloaded,
+    QuotaExceeded,
+    ServeError,
+    lookup_replica,
+)
+from tfk8s_tpu.utils.logging import get_logger
+
+log = get_logger("gateway")
+
+# how long a fetched TPUServe spec (tenancy, queue limit) stays fresh
+SPEC_TTL_S = 1.0
+DEFAULT_TENANT = "default"
+# server-side ceiling on a single request's deadline
+MAX_TIMEOUT_S = 120.0
+# Retry-After when a replica shed without a hint of its own
+DEFAULT_RETRY_AFTER_S = 0.1
+
+
+def _err_body(status: int, reason: str, message: str,
+              details: Optional[Dict[str, Any]] = None) -> bytes:
+    # the k8s metav1.Status failure envelope (apiserver parity)
+    body = {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "code": status,
+        "reason": reason,
+        "message": message,
+    }
+    if details:
+        body["details"] = details
+    return json.dumps(body).encode()
+
+
+def _wire_error(exc: Exception) -> Tuple[int, str, Dict[str, Any], Dict[str, str]]:
+    """Map a typed error to (status, reason, details, extra_headers) —
+    the one place the taxonomy meets HTTP status codes."""
+    headers: Dict[str, str] = {}
+    if isinstance(exc, QuotaExceeded):
+        headers["Retry-After"] = f"{exc.retry_after_s:.3f}"
+        return 429, "QuotaExceeded", {
+            "tenant": exc.tenant,
+            "quota": exc.reason,
+            "retryAfterS": round(exc.retry_after_s, 3),
+        }, headers
+    if isinstance(exc, Overloaded):
+        retry = exc.retry_after_s or DEFAULT_RETRY_AFTER_S
+        headers["Retry-After"] = f"{retry:.3f}"
+        return 429, "Overloaded", {
+            "queueDepth": exc.queue_depth,
+            "queueLimit": exc.queue_limit,
+            "retryAfterS": round(retry, 3),
+        }, headers
+    if isinstance(exc, InvalidRequest):
+        return 400, "InvalidRequest", {}, headers
+    if isinstance(exc, NotFound):
+        return 404, "NotFound", {}, headers
+    if isinstance(exc, Unavailable):
+        return 503, "Unavailable", {}, headers
+    if isinstance(exc, DeadlineExceeded):
+        return 504, "DeadlineExceeded", {}, headers
+    # Draining should be absorbed by the dispatch loop; RequestFailed and
+    # any other ServeError are the model's failure, a plain 500
+    return 500, "RequestFailed", {}, headers
+
+
+class _LeanHeaders(dict):
+    """Header mapping with case-insensitive ``get`` — keys are stored
+    lowercased by the fast-path parser below."""
+
+    def get(self, key, default=None):  # type: ignore[override]
+        return dict.get(self, key.lower(), default)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    # response header block and body are separate send()s: without
+    # TCP_NODELAY, Nagle + the peer's delayed ACK stalls the tail of
+    # every response ~40ms — dwarfing the actual serving latency
+    disable_nagle_algorithm = True
+    server: "GatewayServer"
+
+    # Date header cache: (whole_second, formatted) — strftime per response
+    # is measurable at saturation and the value only changes once a second
+    _date_cache = (-1, "")
+
+    def log_message(self, *a):  # route through our logger, debug level
+        log.debug("http: " + a[0], *a[1:])
+
+    def parse_request(self) -> bool:
+        """Fast-path request parse for the serving hot loop.
+
+        The stdlib implementation funnels every request's headers through
+        the email-package parser (~100us on one core — a third of the
+        whole wire budget at saturation). Plain ``HTTP/1.x`` requests take
+        a split/partition parse instead; anything unusual falls back to
+        the stdlib parser before any header bytes are consumed.
+        """
+        self.close_connection = True
+        try:
+            requestline = self.raw_requestline.decode("latin-1")
+            command, path, version = requestline.rstrip("\r\n").split(" ")
+        except (UnicodeDecodeError, ValueError):
+            return super().parse_request()
+        if version not in ("HTTP/1.1", "HTTP/1.0"):
+            return super().parse_request()
+        self.requestline = requestline.rstrip("\r\n")
+        self.command, self.path, self.request_version = command, path, version
+        headers = _LeanHeaders()
+        rfile = self.rfile
+        while True:
+            line = rfile.readline(65537)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if len(line) > 65536:
+                self.send_error(431)
+                return False
+            name, sep, value = line.partition(b":")
+            if sep:
+                headers[name.decode("latin-1").strip().lower()] = (
+                    value.decode("latin-1").strip()
+                )
+        self.headers = headers
+        conntype = (headers.get("connection") or "").lower()
+        if conntype == "close":
+            self.close_connection = True
+        elif version == "HTTP/1.1":
+            self.close_connection = False
+        else:
+            self.close_connection = conntype != "keep-alive"
+        if (headers.get("expect", "").lower() == "100-continue"
+                and version == "HTTP/1.1"):
+            if not self.handle_expect_100():
+                return False
+        return True
+
+    def date_time_string(self, timestamp=None):  # type: ignore[override]
+        if timestamp is not None:
+            return super().date_time_string(timestamp)
+        now = int(time.time())
+        cached = _Handler._date_cache
+        if cached[0] == now:
+            return cached[1]
+        value = super().date_time_string(now)
+        _Handler._date_cache = (now, value)
+        return value
+
+    def _send_json(self, status: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_status_error(
+        self, status: int, reason: str, message: str,
+        details: Optional[Dict[str, Any]] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        body = _err_body(status, reason, message, details)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        if self.path == "/healthz":
+            self._send_json(200, {"status": "ok"})
+            return
+        self._send_status_error(404, "NotFound", self.path)
+
+    def do_POST(self) -> None:
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) != 4 or parts[0] != "v1" or parts[1] != "serve":
+            self._send_status_error(404, "NotFound", self.path)
+            return
+        namespace, name = parts[2], parts[3]
+        tenant = self.headers.get("X-Tenant", "").strip() or DEFAULT_TENANT
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, TypeError):
+            self._send_status_error(400, "BadRequest", "body must be JSON")
+            return
+        timeout = min(float(body.get("timeoutS") or 30.0), MAX_TIMEOUT_S)
+        serve_label = f"{namespace}/{name}"
+        m = self.server.metrics
+        t0 = time.perf_counter()
+        result = None
+        err: Optional[Exception] = None
+        code = 200
+        try:
+            result = self.server.dispatch(
+                namespace, name, tenant, body.get("payload"), timeout
+            )
+        except Exception as exc:  # noqa: BLE001 - mapped to typed wire errors
+            err = exc
+            code, reason, details, headers = _wire_error(exc)
+            if not isinstance(exc, (ServeError, NotFound, Unavailable)):
+                log.warning("gateway 500 on %s: %s", serve_label, exc)
+        # metrics land BEFORE the response bytes: a caller observing its
+        # own 200 must find the series already incremented
+        if m is not None:
+            labels = {"serve": serve_label, "tenant": tenant}
+            m.observe(
+                "tfk8s_gateway_request_seconds",
+                time.perf_counter() - t0, labels,
+            )
+            m.inc("tfk8s_gateway_requests_total", 1.0,
+                  {**labels, "code": str(code)})
+            if code == 429:
+                m.inc("tfk8s_gateway_shed_total", 1.0, {
+                    **labels,
+                    "reason": getattr(err, "shed_reason", None)
+                    or getattr(err, "reason", None) or "overloaded",
+                })
+        if err is None:
+            self._send_json(200, {"result": result})
+        else:
+            self._send_status_error(code, reason, str(err), details, headers)
+
+
+class _ServeState:
+    """Per-TPUServe routing + admission, plus the TTL-cached spec bits
+    the hot path needs (queue limit, tenancy)."""
+
+    __slots__ = ("table", "admission", "queue_limit", "fetched")
+
+    def __init__(self, table: RouteTable):
+        self.table = table
+        self.admission = TenantAdmission()
+        self.queue_limit = 0
+        self.fetched = 0.0
+
+
+class GatewayServer(ThreadingHTTPServer):
+    """Threaded HTTP serving front door over one clientset. ``port=0``
+    binds an ephemeral port (tests); ``serve_background()`` runs on a
+    daemon thread and returns the bound port."""
+
+    daemon_threads = True
+    # an open-loop load generator keeps many keep-alive connections
+    request_queue_size = 128
+
+    def __init__(self, clientset, host: str = "127.0.0.1", port: int = 0,
+                 metrics=None):
+        self._cs = clientset
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.describe(
+                "tfk8s_gateway_request_seconds",
+                "End-to-end wall time per gateway request, by serve/tenant.",
+            )
+            metrics.describe(
+                "tfk8s_gateway_queue_seconds",
+                "Admission + routing delay before a request's final "
+                "dispatch to a replica.",
+            )
+            metrics.describe(
+                "tfk8s_gateway_shed_total",
+                "Requests shed with a typed 429, by tenant and reason "
+                "(qps/concurrency/priority/overloaded).",
+            )
+            metrics.describe(
+                "tfk8s_gateway_requests_total",
+                "Gateway requests answered, by serve/tenant/status code.",
+            )
+            metrics.describe(
+                "tfk8s_gateway_route_replicas",
+                "Routable replicas in the route table, per serve.",
+            )
+            metrics.describe(
+                "tfk8s_gateway_route_depth",
+                "Least effective queue depth across routable replicas.",
+            )
+        self.stopping = threading.Event()
+        self._states: Dict[Tuple[str, str], _ServeState] = {}
+        self._states_lock = threading.Lock()
+        # route tables learn of drains the instant replicas unregister
+        self._drain_hook: Callable[[str], None] = self._on_drain
+        serving.add_drain_hook(self._drain_hook)
+        super().__init__((host, port), _Handler)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server_address[0]}:{self.port}"
+
+    def serve_background(self) -> int:
+        t = threading.Thread(target=self.serve_forever, daemon=True,
+                             name="gateway")
+        t.start()
+        return self.port
+
+    def shutdown(self) -> None:  # type: ignore[override]
+        self.stopping.set()
+        serving.remove_drain_hook(self._drain_hook)
+        super().shutdown()
+
+    def _on_drain(self, key: str) -> None:
+        with self._states_lock:
+            tables = [s.table for s in self._states.values()]
+        for table in tables:
+            table.mark_draining(key)
+
+    # -- request path --------------------------------------------------------
+
+    def state_for(self, namespace: str, name: str) -> _ServeState:
+        """The (ns, name) routing/admission state, spec-refreshed within
+        SPEC_TTL_S. Raises store.NotFound for an unknown TPUServe."""
+        now = time.monotonic()
+        with self._states_lock:
+            state = self._states.get((namespace, name))
+        if state is not None and now - state.fetched < SPEC_TTL_S:
+            return state
+        try:
+            serve = self._cs.tpuserves(namespace).get(name)
+        except NotFound:
+            with self._states_lock:
+                self._states.pop((namespace, name), None)
+            raise
+        with self._states_lock:
+            state = self._states.get((namespace, name))
+            if state is None:
+                state = _ServeState(RouteTable(
+                    self._cs, name, namespace, metrics=self.metrics,
+                ))
+                self._states[(namespace, name)] = state
+            state.queue_limit = serve.spec.batching.queue_limit
+            state.fetched = now
+        state.admission.configure(serve.spec.tenancy)
+        return state
+
+    def dispatch(self, namespace: str, name: str, tenant: str,
+                 payload: Any, timeout: float) -> Any:
+        """Admit, route least-loaded, submit; absorb Draining/vanished
+        replicas by re-routing inside the deadline."""
+        state = self.state_for(namespace, name)
+        deadline = time.monotonic() + timeout
+        t0 = time.perf_counter()
+        release = state.admission.admit(
+            tenant, state.table.least_depth(), state.queue_limit
+        )
+        try:
+            exclude: set = set()
+            backoff = 0.005
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceeded(
+                        f"no replica of {namespace}/{name} served the "
+                        f"request within {timeout}s"
+                    )
+                key = state.table.pick(exclude)
+                if key is None:
+                    if exclude:
+                        exclude = set()  # full rescan before backing off
+                        continue
+                    if timeout - remaining + backoff > timeout * 0.5:
+                        # half the deadline burned with NOTHING routable:
+                        # surface it as capacity, not a deadline miss
+                        raise Unavailable(
+                            f"{namespace}/{name}: no routable replica"
+                        )
+                    time.sleep(min(backoff, remaining))
+                    backoff = min(backoff * 2, 0.25)
+                    continue
+                server = lookup_replica(key)
+                if server is None:
+                    state.table.release(key)
+                    exclude.add(key)
+                    continue
+                try:
+                    if self.metrics is not None:
+                        self.metrics.observe(
+                            "tfk8s_gateway_queue_seconds",
+                            time.perf_counter() - t0,
+                            {"serve": f"{namespace}/{name}"},
+                        )
+                    return server.submit(payload, timeout=remaining)
+                except Draining:
+                    # rolling out from under us — retry the next-least-
+                    # loaded replica (the zero-failed-request contract)
+                    exclude.add(key)
+                    continue
+                finally:
+                    state.table.release(key)
+        finally:
+            release()

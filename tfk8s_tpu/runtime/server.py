@@ -60,13 +60,34 @@ class ServeError(Exception):
 class Overloaded(ServeError):
     """Bounded-queue backpressure: the request was shed, not queued — the
     typed 429 equivalent. Carries the observed depth and the limit so a
-    client/load-balancer can back off intelligently."""
+    client/load-balancer can back off intelligently; ``retry_after_s``
+    (when the shedder knows one — the gateway's priority bands do) is the
+    hint clients turn into a jittered backoff instead of re-hammering."""
 
-    def __init__(self, queue_depth: int, queue_limit: int):
+    def __init__(self, queue_depth: int, queue_limit: int,
+                 retry_after_s: Optional[float] = None):
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
+        self.retry_after_s = retry_after_s
         super().__init__(
             f"request queue full ({queue_depth}/{queue_limit}); retry later"
+        )
+
+
+class QuotaExceeded(ServeError):
+    """The TENANT's admission budget (gateway/admission.py: token-bucket
+    QPS or the concurrency cap from its TenantQuota) is exhausted — the
+    per-tenant 429. Distinct from :class:`Overloaded`: the cluster may
+    have headroom; THIS tenant does not, which is what keeps one abusive
+    tenant from starving the rest. ``retry_after_s`` is when the bucket
+    accrues the next token."""
+
+    def __init__(self, tenant: str, retry_after_s: float, reason: str = "qps"):
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+        super().__init__(
+            f"tenant {tenant!r} over {reason} quota; retry in {retry_after_s:.3f}s"
         )
 
 
@@ -1290,6 +1311,11 @@ class ModelServer:
 _registry_lock = threading.Lock()
 # ModelServer or DecodeLoopExecutor — one submit/drain/report surface
 _REPLICAS: Dict[str, Any] = {}
+# callbacks fired (outside the lock) when a replica unregisters — the
+# gateway's route tables subscribe so a Draining replica leaves the
+# routing set the instant the drain starts, BEFORE the kubelet flush
+# would publish it (the wire half of the zero-failed-request contract)
+_drain_hooks: List[Callable[[str], None]] = []
 
 
 def register_replica(key: str, server: Any) -> None:
@@ -1297,9 +1323,26 @@ def register_replica(key: str, server: Any) -> None:
         _REPLICAS[key] = server
 
 
+def add_drain_hook(fn: Callable[[str], None]) -> None:
+    with _registry_lock:
+        _drain_hooks.append(fn)
+
+
+def remove_drain_hook(fn: Callable[[str], None]) -> None:
+    with _registry_lock:
+        if fn in _drain_hooks:
+            _drain_hooks.remove(fn)
+
+
 def unregister_replica(key: str) -> None:
     with _registry_lock:
         _REPLICAS.pop(key, None)
+        hooks = list(_drain_hooks)
+    for fn in hooks:  # outside the lock: hooks may take their own locks
+        try:
+            fn(key)
+        except Exception:  # noqa: BLE001 - a bad subscriber can't block drain
+            log.exception("drain hook failed for %s", key)
 
 
 def lookup_replica(key: str) -> Optional[Any]:
@@ -1425,8 +1468,14 @@ class ServeClient:
     a pod list through the clientset (label selector, the endpoints-list
     analogue); dispatch goes through the in-process replica registry.
     Draining/vanished replicas are retried transparently on another
-    replica (the zero-failed-requests rollout contract); Overloaded is
-    surfaced to the caller — backpressure is the point."""
+    replica (the zero-failed-requests rollout contract). Overloaded is
+    backpressure and is HONORED: the client backs off for the shedder's
+    ``retry_after_s`` hint (jittered, so a thousand shed callers don't
+    re-arrive in lockstep) and retries inside the caller's deadline; only
+    when the deadline can't absorb the backoff does it propagate."""
+
+    #: base backoff when a shed carries no retry_after_s hint
+    OVERLOAD_BACKOFF_S = 0.05
 
     def __init__(self, clientset, name: str, namespace: str = "default",
                  cache_ttl_s: float = 0.25):
@@ -1457,6 +1506,7 @@ class ServeClient:
         deadline = time.monotonic() + timeout
         refresh = False
         backoff = 0.02
+        shed_backoff = self.OVERLOAD_BACKOFF_S
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -1489,6 +1539,25 @@ class ServeClient:
                 # replica is rolling out from under us — retry elsewhere
                 refresh = True
                 continue
+            except Overloaded as exc:
+                delay = jittered_backoff(exc.retry_after_s, shed_backoff)
+                if delay >= deadline - time.monotonic():
+                    # the deadline can't absorb the backoff — surface the
+                    # shed rather than burn the wait and time out anyway
+                    raise
+                time.sleep(delay)
+                shed_backoff = min(shed_backoff * 2, 1.0)
+                refresh = True
+
+
+def jittered_backoff(retry_after_s: Optional[float], fallback_s: float) -> float:
+    """Turn a shedder's Retry-After hint (or a client-side fallback) into
+    an actual sleep: uniformly jittered over [0.5x, 1.5x] so shed callers
+    decorrelate instead of re-arriving in lockstep at the hinted instant."""
+    import random
+
+    base = retry_after_s if retry_after_s and retry_after_s > 0 else fallback_s
+    return base * (0.5 + random.random())
 
 
 def template_hash(wire_fragment: Any) -> str:
@@ -1511,12 +1580,16 @@ __all__ = [
     "ModelServer",
     "Overloaded",
     "PagedGptDecoder",
+    "QuotaExceeded",
     "RequestFailed",
     "ServeClient",
     "ServeError",
     "ServedModel",
+    "add_drain_hook",
+    "jittered_backoff",
     "make_model",
     "register_replica",
+    "remove_drain_hook",
     "replica_is_ready",
     "serve",
     "set_metrics",

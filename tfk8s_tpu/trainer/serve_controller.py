@@ -508,6 +508,9 @@ class TPUServeController:
         st.replicas = len(live)
         st.ready_replicas = len(ready_new) + len(ready_old)
         st.updated_replicas = len(new)
+        st.endpoint = (
+            f"/v1/serve/{serve.metadata.namespace}/{serve.metadata.name}"
+        )
         rollout_done = len(new) == len(live) and len(ready_new) >= serve.spec.replicas
         if rollout_done:
             st.observed_version = version

@@ -32,6 +32,10 @@ SCOPE = (
     "tfk8s_tpu/runtime/server.py",
     "tfk8s_tpu/runtime/registry.py",
     "tfk8s_tpu/runtime/paging.py",
+    "tfk8s_tpu/gateway/server.py",
+    "tfk8s_tpu/gateway/router.py",
+    "tfk8s_tpu/gateway/admission.py",
+    "tfk8s_tpu/gateway/client.py",
 )
 
 SEED_ROOTS = {
