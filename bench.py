@@ -696,6 +696,7 @@ def _gateway_probe(small: bool, full: bool = False):
     from tfk8s_tpu.client.store import StoreError
     from tfk8s_tpu.gateway.client import GatewayClient
     from tfk8s_tpu.gateway.server import GatewayServer
+    from tfk8s_tpu.obs import trace as obstrace
     from tfk8s_tpu.runtime import LocalKubelet
     from tfk8s_tpu.runtime.server import ServeClient, ServeError
     from tfk8s_tpu.trainer import TPUServeController
@@ -712,6 +713,10 @@ def _gateway_probe(small: bool, full: bool = False):
 
     flush0 = kubelet_mod.LOG_FLUSH_SECONDS
     period0 = sc_mod.AUTOSCALE_PERIOD_S
+    # the untraced baseline must truly be untraced: park the process
+    # tracer behind a disabled one for the main sweeps; the traced arm
+    # swaps in a live tracer + tail sampler for its re-run only
+    prev_tracer = obstrace.set_tracer(obstrace.Tracer(enabled=False))
     kubelet_mod.LOG_FLUSH_SECONDS = 0.05
     sc_mod.AUTOSCALE_PERIOD_S = 0.1
     cs = FakeClientset()
@@ -763,11 +768,11 @@ def _gateway_probe(small: bool, full: bool = False):
                     shed["untyped"] += 1
                 return None
 
-        def sweep_with(request_fn):
+        def sweep_with(request_fn, use_rates=None):
             # same open-loop pacing as _serving_probe: the clock, not the
             # responses, paces submission
             sweep = []
-            for rate in rates:
+            for rate in (rates if use_rates is None else use_rates):
                 n = int(rate * dur)
                 interval = 1.0 / rate
                 futs = []
@@ -803,6 +808,28 @@ def _gateway_probe(small: bool, full: bool = False):
         wire = sweep_with(lambda: wire_client.request(1.0, timeout=10))
         inproc_client = ServeClient(cs, name)
         inproc = sweep_with(lambda: inproc_client.request(1.0, timeout=10))
+
+        # -- traced re-run (ISSUE 11): the SAME wire workload at the top
+        # offered rate with the request-tracing pipeline live — W3C
+        # propagation client -> gateway -> replica, tail sampling at the
+        # default keep probability, exemplars, ring export. Acceptance:
+        # achieved QPS within 5% of the untraced wire run at this rate.
+        traced_tracer = obstrace.Tracer()
+        traced_tracer.set_sampler(obstrace.TailSampler())
+        obstrace.set_tracer(traced_tracer)
+        try:
+            traced = sweep_with(
+                lambda: wire_client.request(1.0, timeout=10),
+                use_rates=rates[-1:],
+            )[0]
+        finally:
+            obstrace.set_tracer(obstrace.Tracer(enabled=False))
+        # ring-sizing audit: at the top benched rate the default
+        # TFK8S_TRACE_RING capacity plus the tail sampler must not evict
+        # kept spans — ring_full == 0 says the ring is sized for this
+        # load; "sampled" counts the fast successes the sampler shed
+        trace_dropped = dict(traced_tracer.dropped)
+        trace_kept = len(traced_tracer.spans())
 
         # -- fairness round: N tenants, then the same N plus one tenant
         # offering 10x ITS quota — its excess must die at its own bucket,
@@ -876,6 +903,14 @@ def _gateway_probe(small: bool, full: bool = False):
                 top_wire["achieved_qps"] / max(top_inproc["achieved_qps"], 1),
                 3,
             ),
+            "gateway_traced_qps": traced["achieved_qps"],
+            "gateway_traced_p99_ms": traced["p99_ms"],
+            "gateway_trace_overhead": round(
+                1.0 - traced["achieved_qps"]
+                / max(top_wire["achieved_qps"], 1.0), 3,
+            ),
+            "gateway_trace_kept_spans": trace_kept,
+            "gateway_trace_spans_dropped": trace_dropped,
             "gateway_fairness_ratio": round(fairness, 3),
             "gateway_served_good_alone": good_alone,
             "gateway_served_good_with_abuser": good_contended,
@@ -890,6 +925,7 @@ def _gateway_probe(small: bool, full: bool = False):
         ctrl.controller.shutdown()
         kubelet_mod.LOG_FLUSH_SECONDS = flush0
         sc_mod.AUTOSCALE_PERIOD_S = period0
+        obstrace.set_tracer(prev_tracer)
 
 
 def _gen_serving_probe(small: bool, full: bool = False):
@@ -967,7 +1003,7 @@ def _gen_serving_probe(small: bool, full: bool = False):
 
     def run_arm(submit_one, warm):
         warm()
-        lat, toks = [], []
+        lat, toks, ttfts = [], [], []
         with ThreadPoolExecutor(max_workers=64) as pool:
             t_start = time.perf_counter()
             futs = []
@@ -978,12 +1014,14 @@ def _gen_serving_probe(small: bool, full: bool = False):
                     time.sleep(target - now)
                 futs.append(pool.submit(submit_one, r))
             for f in futs:
-                lat_s, n_tok = f.result()
+                lat_s, n_tok, ttft_s = f.result()
                 lat.append(lat_s)
                 toks.append(n_tok)
+                if ttft_s is not None:
+                    ttfts.append(ttft_s)
             elapsed = time.perf_counter() - t_start
         tpot = sorted(l / max(t, 1) for l, t in zip(lat, toks))
-        return {
+        out = {
             "tokens_per_s": round(useful / elapsed, 1),
             "wall_s": round(elapsed, 3),
             "tpot_p50_ms": round(tpot[len(tpot) // 2] * 1000, 3),
@@ -991,6 +1029,15 @@ def _gen_serving_probe(small: bool, full: bool = False):
                 tpot[min(int(len(tpot) * 0.99), len(tpot) - 1)] * 1000, 3
             ),
         }
+        if ttfts:
+            # exact per-request first-token latencies from the reply
+            # payload (ISSUE 11) — not bucket-edge approximations
+            ttfts.sort()
+            out["ttft_p50_ms"] = round(ttfts[len(ttfts) // 2] * 1000, 3)
+            out["ttft_p99_ms"] = round(
+                ttfts[min(int(len(ttfts) * 0.99), len(ttfts) - 1)] * 1000, 3
+            )
+        return out
 
     # -- continuous-batching arm -------------------------------------------
     dec = PagedGptDecoder(
@@ -1005,7 +1052,7 @@ def _gen_serving_probe(small: bool, full: bool = False):
         def loop_one(r):
             t0 = time.perf_counter()
             out = loop.submit(r, timeout=300)
-            return time.perf_counter() - t0, len(out["tokens"])
+            return time.perf_counter() - t0, len(out["tokens"]), out.get("ttft_s")
 
         cb = run_arm(
             loop_one,
@@ -1036,8 +1083,9 @@ def _gen_serving_probe(small: bool, full: bool = False):
             t0 = time.perf_counter()
             base.submit(r["tokens"], timeout=600)
             # useful output is what the client ASKED for; the rest of the
-            # fixed gen_hi continuation is over-generation
-            return time.perf_counter() - t0, r["gen_tokens"]
+            # fixed gen_hi continuation is over-generation. No TTFT: the
+            # baseline only replies once the whole batch finishes.
+            return time.perf_counter() - t0, r["gen_tokens"], None
 
         def base_warm():
             # one compile per distinct prompt length — the baseline's
@@ -1065,6 +1113,8 @@ def _gen_serving_probe(small: bool, full: bool = False):
         "gen_wall_s": cb["wall_s"],
         "tpot_p50_ms": cb["tpot_p50_ms"],
         "tpot_p99_ms": cb["tpot_p99_ms"],
+        "ttft_p50_ms": cb.get("ttft_p50_ms"),
+        "ttft_p99_ms": cb.get("ttft_p99_ms"),
         "gen_mean_live_slots": cb_occupancy,
         "gen_prefix_cache_hits": cb_hits,
         "gen_tokens_per_s_baseline": bl["tokens_per_s"],
@@ -1971,6 +2021,7 @@ def build_headline(
                 for k in (
                     "gen_tokens_per_s",
                     "tpot_p99_ms",
+                    "ttft_p99_ms",
                     "gen_speedup_vs_batch",
                     "gen_tokens_per_s_baseline",
                 )
@@ -1989,6 +2040,7 @@ def build_headline(
                     "gateway_qps",
                     "gateway_p99_ms",
                     "gateway_wire_efficiency",
+                    "gateway_trace_overhead",
                     "gateway_fairness_ratio",
                 )
                 if k in gateway_block
@@ -2025,12 +2077,14 @@ def build_headline(
         "serving_model", "serving_p50_ms", "serving_batch_occupancy",
         "recovery_backoff_burned",
         "gen_tokens_per_s_baseline", "gen_speedup_vs_batch",
+        "gateway_trace_overhead",
         "gateway_wire_efficiency", "gateway_p99_ms",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
         "image_meets_budget", "img_per_sec_native",
         "serving_p99_ms", "serving_qps",
         "gateway_fairness_ratio", "gateway_qps",
+        "ttft_p99_ms",
         "tpot_p99_ms", "gen_tokens_per_s",
         "recovery_p99_s", "recovery_p50_s",
         "image_decode_images_per_sec", "bert_base_mlm_step_time_ms",

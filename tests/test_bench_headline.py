@@ -82,6 +82,8 @@ FULL_GEN_SERVING_BLOCK = {
     "gen_wall_s": 2.963,
     "tpot_p50_ms": 41.2,
     "tpot_p99_ms": 210.7,
+    "ttft_p50_ms": 93.4,
+    "ttft_p99_ms": 402.8,
     "gen_mean_live_slots": 7.69,
     "gen_prefix_cache_hits": 43,
     "gen_tokens_per_s_baseline": 456.7,
@@ -110,6 +112,11 @@ FULL_GATEWAY_BLOCK = {
     "gateway_p99_ms": 88.0,
     "gateway_inprocess_qps": 3911.0,
     "gateway_wire_efficiency": 0.849,
+    "gateway_traced_qps": 3260.2,
+    "gateway_traced_p99_ms": 91.5,
+    "gateway_trace_overhead": 0.018,
+    "gateway_trace_kept_spans": 182,
+    "gateway_trace_spans_dropped": {"sampled": 11342},
     "gateway_fairness_ratio": 0.981,
     "gateway_served_good_alone": 200,
     "gateway_served_good_with_abuser": 196,
@@ -165,6 +172,14 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert parsed["extra"]["tpot_p99_ms"] == 210.7
     assert parsed["extra"]["gen_speedup_vs_batch"] == 2.7
     assert parsed["extra"]["gen_tokens_per_s_baseline"] == 456.7
+    # ISSUE-11 observability acceptance keys
+    assert parsed["extra"]["ttft_p99_ms"] == 402.8
+    assert parsed["extra"]["gateway_trace_overhead"] == 0.018
+    # ...but the trace detail (ring audit, kept-span count) stays in
+    # the detail record, off the headline
+    assert "gateway_trace_spans_dropped" not in parsed["extra"]
+    assert "gateway_trace_kept_spans" not in parsed["extra"]
+    assert "gateway_traced_qps" not in parsed["extra"]
     # ISSUE-10 gateway acceptance keys
     assert parsed["extra"]["gateway_qps"] == 3320.5
     assert parsed["extra"]["gateway_p99_ms"] == 88.0
@@ -211,8 +226,9 @@ def test_serving_keys_in_drop_order():
                 "serving_batch_occupancy", "serving_model",
                 "recovery_p50_s", "recovery_p99_s",
                 "recovery_backoff_burned",
-                "gen_tokens_per_s", "tpot_p99_ms",
+                "gen_tokens_per_s", "tpot_p99_ms", "ttft_p99_ms",
                 "gen_speedup_vs_batch", "gen_tokens_per_s_baseline",
                 "gateway_qps", "gateway_p99_ms",
-                "gateway_wire_efficiency", "gateway_fairness_ratio"):
+                "gateway_wire_efficiency", "gateway_trace_overhead",
+                "gateway_fairness_ratio"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

@@ -257,7 +257,7 @@ class _SheddingReplica:
         self.sheds = sheds
         self.calls = 0
 
-    def submit(self, payload, timeout=None):
+    def submit(self, payload, timeout=None, **kwargs):
         self.calls += 1
         if self.calls <= self.sheds:
             raise Overloaded(10, 10, retry_after_s=0.2)
@@ -313,7 +313,8 @@ class TestGatewayClientShedBackoff:
             (200, {}, json.dumps({"result": {"version": "v1"}}).encode()),
         ]
         monkeypatch.setattr(
-            GatewayClient, "_roundtrip", lambda self, body: responses.pop(0)
+            GatewayClient, "_roundtrip",
+            lambda self, body, traceparent="": responses.pop(0),
         )
         client = GatewayClient("http://127.0.0.1:1", "s", tenant="t")
         assert client.request(1.0, timeout=5) == {"version": "v1"}

@@ -5,7 +5,12 @@ namespace stays coherent as instrumentation grows."""
 
 import os
 
-from tools.check_metric_names import default_paths, lint_paths, lint_source
+from tools.check_metric_names import (
+    default_paths,
+    lint_exposition,
+    lint_paths,
+    lint_source,
+)
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -63,6 +68,10 @@ def test_default_scope_covers_hotpath_counters():
         "tfk8s_gateway_requests_total": False,
         "tfk8s_gateway_route_replicas": False,
         "tfk8s_gateway_route_depth": False,
+        # ISSUE-11 request-observability series: the traced bench arm and
+        # the tracing e2e key off these exact names
+        "tfk8s_serving_ttft_seconds": False,
+        "tfk8s_trace_spans_dropped_total": False,
     }
     for root in default_paths():
         if os.path.isfile(root):
@@ -99,3 +108,30 @@ def test_lint_catches_bad_names():
     assert any("_total" in p for p in problems)
     assert any("_seconds" in p for p in problems)
     assert any("snake_case" in p for p in problems)
+
+
+def test_exposition_lint_accepts_exemplar_suffix():
+    """The exemplar suffix on bucket lines is legal exposition — the
+    lint must not flag it (ISSUE-11: exemplars on latency families)."""
+    text = "\n".join(
+        [
+            "# HELP tfk8s_gateway_request_seconds end-to-end latency",
+            "# TYPE tfk8s_gateway_request_seconds histogram",
+            'tfk8s_gateway_request_seconds_bucket{le="0.005"} 3'
+            ' # {trace_id="deadbeefdeadbeefdeadbeefdeadbeef"} 0.004',
+            'tfk8s_gateway_request_seconds_bucket{le="+Inf"} 7'
+            ' # {trace_id="deadbeefdeadbeefdeadbeefdeadbeef"} 0.2',
+            "tfk8s_gateway_request_seconds_sum 0.42",
+            "tfk8s_gateway_request_seconds_count 7",
+        ]
+    )
+    assert lint_exposition(text) == []
+
+
+def test_exposition_lint_rejects_misplaced_exemplar():
+    # exemplars anchor histogram observations; a counter line carrying
+    # one is malformed exposition
+    bad = 'tfk8s_gateway_requests_total 9 # {trace_id="abcd"} 1.0'
+    problems = lint_exposition(bad)
+    assert len(problems) == 1 and "non-bucket" in problems[0]
+    assert lint_exposition("not a metric line!") != []

@@ -476,7 +476,14 @@ def test_apiserver_per_verb_latency_metrics_and_exposition():
             f"{base}/apis/{API_VERSION}/namespaces/default/pods", timeout=5
         ).read()
         urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
-        assert m.get_counter("apiserver.requests_total", {"verb": "GET"}) >= 2
+        # the per-verb counter lands in _timed's finally AFTER the
+        # response bytes flush — poll past that tiny window
+        assert wait_for(
+            lambda: m.get_counter(
+                "apiserver.requests_total", {"verb": "GET"}
+            ) >= 2,
+            timeout=5,
+        )
         snap = m.snapshot()
         hist = snap["histograms"]['apiserver.request_seconds{verb="GET"}']
         assert hist["count"] >= 2

@@ -1,0 +1,692 @@
+"""Request-scoped observability (ISSUE-11): tail-sampling policy units,
+exemplar exposition, the decode loop's per-request timeline spans, and
+the wire acceptance — ONE trace from GatewayClient through the gateway's
+admission/routing into the decode loop and back, with sheds and deadline
+misses ALWAYS retrievable via /debug/requests and tools/traceview.
+"""
+
+import json
+import random
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import tfk8s_tpu.runtime.kubelet as kubelet_mod
+import tfk8s_tpu.trainer.serve_controller as sc_mod
+from tfk8s_tpu.api.types import (
+    BatchingPolicy,
+    ObjectMeta,
+    TenantPolicy,
+    TenantQuota,
+    TPUServe,
+    TPUServeSpec,
+)
+from tfk8s_tpu.client import FakeClientset
+from tfk8s_tpu.gateway.client import GatewayClient
+from tfk8s_tpu.gateway.server import GatewayServer
+from tfk8s_tpu.obs import trace as obstrace
+from tfk8s_tpu.obs.trace import (
+    Span,
+    TailSampler,
+    Tracer,
+    parse_traceparent,
+    ring_capacity_from_env,
+)
+import tfk8s_tpu.runtime.server as server_mod
+from tfk8s_tpu.runtime import LocalKubelet
+from tfk8s_tpu.runtime.server import (
+    DeadlineExceeded,
+    DecodeLoopExecutor,
+    InvalidRequest,
+    QuotaExceeded,
+)
+from tfk8s_tpu.trainer import TPUServeController
+from tfk8s_tpu.utils.logging import Metrics
+from tools.check_metric_names import lint_exposition
+from tools.traceview import main as traceview_main
+
+from conftest import wait_for
+
+
+@pytest.fixture
+def tracer():
+    """A fresh process-default tracer; restored afterwards so the suite's
+    other e2e tests keep their shared ring."""
+    t = Tracer()
+    prev = obstrace.set_tracer(t)
+    yield t
+    obstrace.set_tracer(prev)
+
+
+def _span(duration=0.001, status="ok", attributes=None):
+    """A finished decision span for feeding TailSampler.decide."""
+    return Span(
+        name="gateway.request", trace_id="ab" * 16, span_id="cd" * 8,
+        parent_id=None, start_time=100.0, end_time=100.0 + duration,
+        attributes=dict(attributes or {}), status=status,
+    )
+
+
+# ------------------------------------------------- tail-sampling units --
+
+
+class TestTailSampler:
+    def test_error_and_status_code_always_kept(self):
+        s = TailSampler(keep_probability=0.0)
+        assert s.decide(_span(status="error")) == (True, "error")
+        assert s.decide(
+            _span(attributes={"http.status_code": 429})
+        ) == (True, "status_code")
+        assert s.decide(
+            _span(attributes={"http.status_code": 504})
+        ) == (True, "status_code")
+        # a 2xx code is not a keep reason
+        assert s.decide(
+            _span(attributes={"http.status_code": 200})
+        ) == (False, "sampled")
+
+    def test_slow_tail_kept_only_once_armed(self):
+        # cold sampler: even a slow span is just "sampled" — no tail yet
+        assert TailSampler(keep_probability=0.0).decide(
+            _span(duration=5.0)
+        )[1] == "sampled"
+        s = TailSampler(keep_probability=0.0)
+        for _ in range(TailSampler.MIN_TAIL_SAMPLES):
+            assert s.decide(_span(duration=0.01)) == (False, "sampled")
+        # armed: a span at/above the windowed p99 is kept as "slow"
+        assert s.decide(_span(duration=1.0)) == (True, "slow")
+        assert s.decide(_span(duration=0.01)) == (False, "sampled")
+
+    def test_probabilistic_coin_is_seeded_and_bounded(self):
+        s = TailSampler(keep_probability=0.5, rng=random.Random(0))
+        outcomes = {s.decide(_span())[0] for _ in range(64)}
+        assert outcomes == {True, False}
+        assert TailSampler(keep_probability=1.0).decide(_span()) == (
+            True, "probabilistic"
+        )
+        assert TailSampler(keep_probability=0.0).decide(_span()) == (
+            False, "sampled"
+        )
+
+    def test_sample_env_knob(self, monkeypatch):
+        monkeypatch.setenv(obstrace.TRACE_SAMPLE_ENV, "0.25")
+        assert TailSampler().keep_probability == 0.25
+        monkeypatch.setenv(obstrace.TRACE_SAMPLE_ENV, "junk")
+        assert TailSampler().keep_probability == (
+            obstrace.DEFAULT_KEEP_PROBABILITY
+        )
+        monkeypatch.setenv(obstrace.TRACE_SAMPLE_ENV, "7")
+        assert TailSampler().keep_probability == 1.0  # clamped
+
+
+class TestTracerTailSampling:
+    def test_fast_success_dropped_and_counted(self):
+        m = Metrics()
+        t = Tracer(sampler=TailSampler(keep_probability=0.0), metrics=m)
+        t.set_metrics(m)
+        with t.start_span("gateway.request", tail_sample=True) as root:
+            with t.start_span("serve.request"):
+                pass
+        assert t.spans() == []
+        assert t.dropped == {"sampled": 2}
+        assert m.get_counter(
+            "tfk8s_trace_spans_dropped_total", {"reason": "sampled"}
+        ) == 2.0
+        assert t.verdict(root.trace_id) is False
+
+    def test_shed_kept_and_late_finisher_follows_verdict(self):
+        t = Tracer(sampler=TailSampler(keep_probability=0.0))
+        root = t.start_span("gateway.request", tail_sample=True)
+        root.set_attribute("http.status_code", 429)
+        late = t.start_span("gateway.client.request", traceparent=root.traceparent)
+        with root:
+            pass  # decision: kept (status_code)
+        assert t.verdict(root.trace_id) is True
+        with late:
+            pass  # finished AFTER the verdict — must still land in ring
+        names = {s.name for s in t.spans()}
+        assert names == {"gateway.request", "gateway.client.request"}
+        assert t.spans()[0].attributes.get("sampling.reason") == "status_code"
+
+    def test_control_plane_spans_bypass_sampling(self):
+        t = Tracer(sampler=TailSampler(keep_probability=0.0))
+        with t.start_span("reconcile"):  # no tail_sample decision span
+            pass
+        assert [s.name for s in t.spans()] == ["reconcile"]
+        assert t.dropped == {}
+
+    def test_ring_capacity_env_and_ring_full_counter(self, monkeypatch):
+        monkeypatch.setenv(obstrace.TRACE_RING_ENV, "64")
+        assert ring_capacity_from_env() == 64
+        monkeypatch.setenv(obstrace.TRACE_RING_ENV, "1")
+        assert ring_capacity_from_env() == 16  # floor
+        monkeypatch.setenv(obstrace.TRACE_RING_ENV, "junk")
+        assert ring_capacity_from_env() == obstrace.DEFAULT_RING_CAPACITY
+
+        m = Metrics()
+        t = Tracer(capacity=2, metrics=m)
+        for i in range(3):
+            t.record_span(f"s{i}", 0.0, 1.0)
+        assert t.dropped == {"ring_full": 1}
+        assert m.get_counter(
+            "tfk8s_trace_spans_dropped_total", {"reason": "ring_full"}
+        ) == 1.0
+
+
+# ------------------------------------------------------ exemplar units --
+
+
+class TestExemplars:
+    def test_exemplar_renders_on_bucket_lines_and_lints(self):
+        m = Metrics()
+        tid = "ab" * 16
+        m.observe(
+            "tfk8s_gateway_request_seconds", 0.004,
+            {"serve": "default/x"}, exemplar=tid,
+        )
+        m.observe("tfk8s_gateway_request_seconds", 0.009, {"serve": "default/x"})
+        text = m.prometheus_text()
+        assert f'# {{trace_id="{tid}"}} 0.004' in text
+        assert lint_exposition(text) == []
+        for line in text.splitlines():
+            if "trace_id" in line:
+                assert "_bucket{" in line
+
+    def test_observe_without_exemplar_renders_plain(self):
+        m = Metrics()
+        m.observe("wait_seconds", 0.1)
+        text = m.prometheus_text()
+        assert "trace_id" not in text
+        assert lint_exposition(text) == []
+
+
+# --------------------------------------- decode-loop timeline (no jax) --
+
+
+class FakeDecoder:
+    """Pure-numpy stand-in for PagedGptDecoder: same packed interface the
+    loop dispatches, zero compile cost — the timeline tests exercise the
+    executor's bookkeeping, not the model."""
+
+    def __init__(self, slots=2, page_size=4, max_pages=32, gen_tokens=4,
+                 prefill_chunk=4, eos_id=None, max_len=24, next_token=5):
+        self.version = "fake"
+        self.slots = slots
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.gen_tokens = gen_tokens
+        self.prefill_chunk = prefill_chunk
+        self.eos_id = eos_id
+        self.max_len = max_len
+        self.next_token = next_token
+
+    @property
+    def pages_per_slot(self):
+        return -(-self.max_len // self.page_size)
+
+    def validate(self, payload):
+        gen = self.gen_tokens
+        if isinstance(payload, dict):
+            gen = int(payload.get("gen_tokens", gen))
+            payload = payload["tokens"]
+        arr = np.asarray(payload).astype(np.int32)
+        if gen < 1:
+            raise InvalidRequest(f"gen_tokens must be >= 1, got {gen}")
+        if arr.shape[0] + gen > self.max_len:
+            raise InvalidRequest("over max_len")
+        return arr, gen
+
+    def prefill_batch(self, batch):
+        return np.full(
+            (batch.shape[0], self.prefill_chunk), self.next_token, np.int32
+        )
+
+    def decode(self, state):
+        nxt = np.full(state.shape[0], self.next_token, np.int32)
+        new_state = state.copy()
+        new_state[:, 0] = nxt
+        new_state[:, 1] += 1
+        return nxt, new_state
+
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+def run_loop(decoder, **kw):
+    kw.setdefault("queue_limit", 8)
+    kw.setdefault("metrics", Metrics())
+    return DecodeLoopExecutor(decoder, **kw).start()
+
+
+class TestDecodeLoopTimeline:
+    def test_request_span_timeline_and_ttft_tpot_metrics(self, tracer):
+        m = Metrics()
+        loop = run_loop(FakeDecoder(), metrics=m, labels={"serve": "d/s"})
+        try:
+            out = loop.submit(
+                {"tokens": list(range(1, 9)), "gen_tokens": 4},
+                timeout=30, traceparent=TP, tenant="acme", priority=2,
+            )
+            assert len(out["tokens"]) == 4
+        finally:
+            loop.drain(10)
+        spans = tracer.find_spans("serve.request")
+        assert len(spans) == 1
+        sp = spans[0]
+        # the span continues the caller's trace, one hop deeper
+        assert (sp.trace_id, sp.parent_id) == parse_traceparent(TP)
+        assert sp.attributes["outcome"] == "budget"
+        assert sp.attributes["tenant"] == "acme"
+        assert sp.attributes["tokens_out"] == 4
+        names = [e["name"] for e in sp.events]
+        assert names[0] == "admitted" and names[1] == "first_token"
+        assert names[-1] == "retire"
+        assert names.count("token") == 3  # prefill token + 3 decode steps
+        admitted = sp.events[0]["attributes"]
+        assert admitted["queue_wait_s"] >= 0 and admitted["cached_pages"] == 0
+        first = sp.events[1]["attributes"]
+        assert first["ttft_s"] > 0 and first["prefill_chunks"] >= 1
+        retire = sp.events[-1]["attributes"]
+        assert retire == {"reason": "budget", "tokens": 4}
+        # TTFT/TPOT families carry the tenant/priority class labels and
+        # an exemplar pointing at this trace
+        cls = {"serve": "d/s", "tenant": "acme", "priority": "2"}
+        assert m.snapshot()["histograms"][
+            'tfk8s_serving_ttft_seconds{priority="2",serve="d/s",tenant="acme"}'
+        ]["count"] == 1
+        text = m.prometheus_text()
+        assert "tfk8s_serving_ttft_seconds_bucket" in text
+        assert "tfk8s_serving_tpot_seconds_bucket" in text
+        assert f'trace_id="{sp.trace_id}"' in text
+        assert lint_exposition(text) == []
+        assert m.get_counter("tfk8s_serving_requests_total",
+                             {"serve": "d/s", "outcome": "ok"}) == 1.0
+        del cls
+
+    def test_eos_retirement_reason(self, tracer):
+        loop = run_loop(FakeDecoder(eos_id=5, gen_tokens=6))
+        try:
+            out = loop.submit([1, 2, 3], timeout=30, traceparent=TP)
+            # prefill emits token 5 == eos: retired at the first token
+            assert out["tokens"] == [5]
+        finally:
+            loop.drain(10)
+        sp = tracer.find_spans("serve.request")[0]
+        assert sp.attributes["outcome"] == "eos"
+        assert sp.events[-1]["attributes"]["reason"] == "eos"
+
+    def test_prefix_cache_pages_surface_in_admitted_event(self, tracer):
+        loop = run_loop(FakeDecoder())
+        try:
+            prompt = list(range(1, 9))  # 8 tokens = 2 cacheable pages
+            loop.submit({"tokens": prompt, "gen_tokens": 2}, timeout=30,
+                        traceparent=TP)
+            loop.submit({"tokens": prompt, "gen_tokens": 2}, timeout=30,
+                        traceparent=TP)
+        finally:
+            loop.drain(10)
+        spans = tracer.find_spans("serve.request")
+        assert len(spans) == 2
+        assert spans[1].attributes["cached_pages"] >= 1
+        assert spans[1].events[0]["attributes"]["cached_pages"] >= 1
+
+    def test_untraced_requests_emit_no_spans(self, tracer):
+        loop = run_loop(FakeDecoder())
+        try:
+            loop.submit([1, 2, 3], timeout=30)  # no traceparent
+        finally:
+            loop.drain(10)
+        assert tracer.find_spans("serve.request") == []
+
+    def test_debug_state_shape(self, tracer):
+        loop = run_loop(FakeDecoder())
+        try:
+            loop.submit([1, 2], timeout=30)
+            state = loop.debug_state()
+        finally:
+            loop.drain(10)
+        assert state["kind"] == "decode_loop"
+        assert state["slot_capacity"] == 2
+        assert state["pages_total"] > 0
+        assert len(state["slots"]) == 2
+
+
+# ------------------------------------------------- retry span events --
+
+
+class _ShedOnceReplica:
+    def __init__(self):
+        self.calls = 0
+
+    def submit(self, payload, timeout=None, **kwargs):
+        self.calls += 1
+        if self.calls == 1:
+            raise server_mod.Overloaded(10, 10, retry_after_s=0.01)
+        return {"ok": payload}
+
+
+class TestRetryEvents:
+    def test_serve_client_retry_annotates_ambient_span(
+        self, tracer, monkeypatch
+    ):
+        replica = _ShedOnceReplica()
+        monkeypatch.setattr(server_mod, "lookup_replica", lambda key: replica)
+        monkeypatch.setattr(
+            server_mod.ServeClient, "ready_replica_keys",
+            lambda self, refresh=False: ["default/p-0"],
+        )
+        client = server_mod.ServeClient(None, "s")
+        with tracer.start_span("caller") as span:
+            assert client.request(1.0, timeout=5) == {"ok": 1.0}
+        retries = [e for e in span.events if e["name"] == "retry"]
+        assert len(retries) == 1
+        ev = retries[0]["attributes"]
+        assert ev["reason"] == "Overloaded"
+        assert ev["replica"] == "default/p-0"
+        assert ev["attempt"] == 1 and ev["backoff_s"] > 0
+
+    def test_gateway_client_retry_annotates_its_root_span(self, tracer):
+        client = GatewayClient("http://127.0.0.1:1", "x")
+        responses = [
+            (429, {"retry-after": "0.01"}, json.dumps({
+                "reason": "Overloaded", "message": "full",
+                "details": {"queueDepth": 10, "queueLimit": 10},
+            }).encode()),
+            (200, {}, json.dumps({"result": {"version": "v1"}}).encode()),
+        ]
+        client._roundtrip = lambda body, traceparent="": responses.pop(0)
+        assert client.request(1.0, timeout=5)["version"] == "v1"
+        span = tracer.find_spans("gateway.client.request")[0]
+        retries = [e for e in span.events if e["name"] == "retry"]
+        assert len(retries) == 1
+        ev = retries[0]["attributes"]
+        assert ev["reason"] == "Overloaded" and ev["status"] == 429
+        assert ev["attempt"] == 1 and ev["backoff_s"] > 0
+        assert span.attributes["http.status_code"] == 200
+
+
+# ----------------------------------------------------------- traceview --
+
+
+class TestTraceview:
+    def _export(self, tmp_path):
+        t = Tracer()
+        with t.start_span("gateway.client.request") as root:
+            with t.start_span("gateway.request"):
+                t.record_span(
+                    "serve.request", 100.0, 100.5,
+                    traceparent=t.current_traceparent(),
+                    attributes={"tokens_out": 2, "cached_pages": 1,
+                                "prefill_chunks": 1},
+                    events=[
+                        {"name": "first_token", "ts": 100.1,
+                         "attributes": {"ttft_s": 0.1, "prefill_chunks": 1}},
+                        {"name": "token", "ts": 100.2,
+                         "attributes": {"i": 1, "tpot_s": 0.1}},
+                        {"name": "retire", "ts": 100.5,
+                         "attributes": {"reason": "eos", "tokens": 2}},
+                    ],
+                )
+        with t.start_span("other.trace"):
+            pass
+        path = tmp_path / "spans.jsonl"
+        t.export_jsonl(str(path))
+        return str(path), root.trace_id
+
+    def test_renders_tree_and_token_timeline(self, tmp_path, capsys):
+        path, tid = self._export(tmp_path)
+        assert traceview_main([path, "--trace-id", tid]) == 0
+        out = capsys.readouterr().out
+        for needle in ("gateway.client.request", "gateway.request",
+                       "serve.request", "token timeline", "ttft",
+                       "retired: eos"):
+            assert needle in out
+        assert traceview_main([path, "--list"]) == 0
+        assert tid in capsys.readouterr().out
+
+    def test_defaults_to_slowest_trace(self, tmp_path, capsys):
+        path, tid = self._export(tmp_path)
+        # the request trace contains a 500ms serve span; "other.trace"
+        # is microseconds — slowest-in-file must pick the request
+        assert traceview_main([path]) == 0
+        assert "serve.request" in capsys.readouterr().out
+
+    def test_missing_trace_and_empty_file_fail(self, tmp_path, capsys):
+        path, _tid = self._export(tmp_path)
+        assert traceview_main([path, "--trace-id", "nope"]) == 1
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert traceview_main([str(empty)]) == 1
+        capsys.readouterr()
+
+
+# ------------------------------------------------------ wire acceptance --
+
+
+def make_echo_serve(name, replicas=1, tenancy=None, delay_ms="2"):
+    serve = TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task="echo", checkpoint="v1", replicas=replicas,
+            batching=BatchingPolicy(
+                max_batch_size=8, batch_timeout_ms=5.0, queue_limit=256
+            ),
+        ),
+    )
+    if tenancy is not None:
+        serve.spec.tenancy = tenancy
+    serve.spec.template.env["TFK8S_SERVE_ECHO_DELAY_MS"] = delay_ms
+    return serve
+
+
+def make_gpt_serve(name):
+    serve = TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task="gpt", checkpoint="seed:0", replicas=1,
+            batching=BatchingPolicy(
+                max_batch_size=4, batch_timeout_ms=2.0, queue_limit=64,
+                page_size=8, max_pages=64,
+            ),
+        ),
+    )
+    serve.spec.template.env["TFK8S_SERVE_GEN_TOKENS"] = "8"
+    serve.spec.template.env["TFK8S_SERVE_GPT_SIZE"] = "tiny"
+    return serve
+
+
+def ready_count(cs, name):
+    try:
+        return cs.tpuserves().get(name).status.ready_replicas
+    except Exception:  # noqa: BLE001
+        return -1
+
+
+def debug_get(gw, path):
+    with urllib.request.urlopen(f"{gw.url}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def cluster(monkeypatch, tracer):
+    """Controller + kubelet + gateway over one fake cluster, with a
+    DETERMINISTIC tail sampler (keep everything) pre-installed on the
+    fresh process tracer — individual tests swap the sampler to prove
+    the always-keep rules."""
+    tracer.set_sampler(TailSampler(keep_probability=1.0))
+    monkeypatch.setattr(kubelet_mod, "LOG_FLUSH_SECONDS", 0.05)
+    monkeypatch.setattr(sc_mod, "AUTOSCALE_PERIOD_S", 0.1)
+    cs = FakeClientset()
+    ctrl = TPUServeController(cs)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    assert ctrl.run(workers=2, stop=stop, block=False)
+    metrics = Metrics()
+    gw = GatewayServer(cs, port=0, metrics=metrics)
+    gw.serve_background()
+    yield cs, gw, metrics, tracer
+    stop.set()
+    gw.shutdown()
+    gw.server_close()
+    ctrl.controller.shutdown()
+
+
+class TestOneTraceEndToEnd:
+    def test_client_gateway_decode_loop_share_one_trace(
+        self, cluster, tmp_path, capsys
+    ):
+        """THE tentpole acceptance: one GatewayClient request yields ONE
+        trace whose parent/child chain is client span -> gateway span
+        (admission + routing as events) -> decode-loop request span
+        (token timeline) — and the trace is retrievable from
+        /debug/requests and renderable by traceview."""
+        cs, gw, metrics, tracer = cluster
+        cs.tpuserves().create(make_gpt_serve("gpt-tr"))
+        assert wait_for(lambda: ready_count(cs, "gpt-tr") == 1, timeout=120)
+
+        client = GatewayClient(gw.url, "gpt-tr")
+        out = client.request(
+            {"tokens": list(range(1, 9)), "gen_tokens": 4}, timeout=60
+        )
+        client.close()
+        assert len(out["tokens"]) == 4
+
+        def trace_complete():
+            by_name = {s.name: s for s in tracer.spans()}
+            return {"gateway.client.request", "gateway.request",
+                    "serve.request"} <= set(by_name)
+        assert wait_for(trace_complete, timeout=10)
+
+        by_name = {s.name: s for s in tracer.spans()}
+        root = by_name["gateway.client.request"]
+        gw_span = by_name["gateway.request"]
+        serve_span = by_name["serve.request"]
+        # ONE trace id across the whole chain, parent links verified
+        assert root.trace_id == gw_span.trace_id == serve_span.trace_id
+        assert root.parent_id is None
+        assert gw_span.parent_id == root.span_id
+        assert serve_span.parent_id == gw_span.span_id
+        # admission + routing rode the gateway span as events
+        gw_events = [e["name"] for e in gw_span.events]
+        assert "admit" in gw_events and "route.pick" in gw_events
+        # the decode loop's timeline made it across the wire boundary
+        serve_events = [e["name"] for e in serve_span.events]
+        assert serve_events[0] == "admitted"
+        assert "first_token" in serve_events
+        assert serve_events[-1] == "retire"
+        assert gw_span.attributes["http.status_code"] == 200
+
+        # the kept trace anchors a histogram exemplar on the gateway
+        # latency family
+        assert f'trace_id="{root.trace_id}"' in metrics.prometheus_text()
+        assert lint_exposition(metrics.prometheus_text()) == []
+
+        # live zpages on the gateway's own HTTP stack
+        dbg = debug_get(gw, f"/debug/requests?trace_id={root.trace_id}")
+        assert len(dbg["recent"]) == 1
+        assert dbg["recent"][0]["trace_id"] == root.trace_id
+        names = {s["name"] for s in dbg["recent"][0]["spans"]}
+        assert "serve.request" in names
+        dec = debug_get(gw, "/debug/decode")
+        assert any(
+            r.get("kind") == "decode_loop" for r in dec["replicas"].values()
+        )
+
+        # traceview renders the exported trace
+        path = tmp_path / "spans.jsonl"
+        tracer.export_jsonl(str(path))
+        assert traceview_main([str(path), "--trace-id", root.trace_id]) == 0
+        rendered = capsys.readouterr().out
+        assert "token timeline" in rendered and "serve.request" in rendered
+
+    def test_shed_and_deadline_always_sampled(self, cluster, tmp_path, capsys):
+        """With the coin rigged to DROP everything, a 429 shed and a
+        deadline-exceeded request still land in the ring (status/error
+        keep rules) while the fast success is dropped — and both are
+        retrievable via /debug/requests and traceview."""
+        cs, gw, _metrics, tracer = cluster
+        tracer.set_sampler(TailSampler(keep_probability=0.0))
+        tenancy = TenantPolicy(
+            enabled=True,
+            tenants={"limited": TenantQuota(qps=0.5, burst=1)},
+            default_quota=TenantQuota(qps=10_000.0),
+        )
+        cs.tpuserves().create(
+            make_echo_serve("echo-tr", tenancy=tenancy)
+        )
+        cs.tpuserves().create(
+            make_echo_serve("slow-tr", delay_ms="500")
+        )
+        assert wait_for(lambda: ready_count(cs, "echo-tr") == 1, timeout=60)
+        assert wait_for(lambda: ready_count(cs, "slow-tr") == 1, timeout=60)
+
+        # fast success: dropped by the rigged coin
+        ok_client = GatewayClient(gw.url, "echo-tr")
+        assert ok_client.request(1.0, timeout=20)["version"] == "v1"
+        ok_client.close()
+        assert wait_for(lambda: tracer.dropped.get("sampled", 0) >= 1, 10)
+        assert tracer.find_spans("serve.request") == []
+
+        # the shed: burst token spent, the retry loop annotates the
+        # client span and the 429 decision keeps the whole trace
+        shed_client = GatewayClient(gw.url, "echo-tr", tenant="limited")
+        assert shed_client.request(2.0, timeout=20)["version"] == "v1"
+        with pytest.raises(QuotaExceeded):
+            shed_client.request(3.0, timeout=0.3)
+        shed_client.close()
+
+        def shed_traced():
+            return any(
+                s.attributes.get("http.status_code") == 429
+                for s in tracer.find_spans("gateway.request")
+            )
+        assert wait_for(shed_traced, timeout=10)
+        shed_span = next(
+            s for s in tracer.find_spans("gateway.request")
+            if s.attributes.get("http.status_code") == 429
+        )
+        assert shed_span.attributes["sampling.reason"] in (
+            "error", "status_code"
+        )
+        shed_events = [e["name"] for e in shed_span.events]
+        assert "shed" in shed_events
+        # the client's root span rode the verdict into the ring too —
+        # the WHOLE trace is retrievable, not just the server half
+        assert any(
+            s.trace_id == shed_span.trace_id
+            for s in tracer.find_spans("gateway.client.request")
+        )
+
+        # the deadline miss: 500ms echo against a 400ms budget
+        slow_client = GatewayClient(gw.url, "slow-tr")
+        with pytest.raises(DeadlineExceeded):
+            slow_client.request(4.0, timeout=0.4)
+        slow_client.close()
+
+        def deadline_traced():
+            return any(
+                s.status == "error" and s.trace_id != shed_span.trace_id
+                for s in tracer.find_spans("gateway.request")
+            )
+        assert wait_for(deadline_traced, timeout=10)
+        dl_span = next(
+            s for s in tracer.find_spans("gateway.request")
+            if s.status == "error" and s.trace_id != shed_span.trace_id
+        )
+
+        # both incidents are live on /debug/requests...
+        for tid in (shed_span.trace_id, dl_span.trace_id):
+            dbg = debug_get(gw, f"/debug/requests?trace_id={tid}")
+            assert len(dbg["recent"]) == 1, tid
+        assert debug_get(gw, "/debug/requests")["spans_dropped"].get(
+            "sampled", 0
+        ) >= 1
+        # ...and renderable offline
+        path = tmp_path / "spans.jsonl"
+        tracer.export_jsonl(str(path))
+        for tid in (shed_span.trace_id, dl_span.trace_id):
+            assert traceview_main([str(path), "--trace-id", tid]) == 0
+        capsys.readouterr()

@@ -346,6 +346,24 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path.split("?", 1)[0] in ("/debug/requests", "/debug/decode"):
+            # zpages ride the same open stance as /metrics — the shared
+            # gateway.server helpers render both bodies
+            from urllib.parse import parse_qs, urlsplit
+
+            from tfk8s_tpu.gateway.server import debug_decode, debug_requests
+            from tfk8s_tpu.obs.trace import get_tracer
+
+            sp = urlsplit(self.path)
+            if sp.path == "/debug/requests":
+                q = {k: v[0] for k, v in parse_qs(sp.query).items()}
+                self._send_json(200, debug_requests(
+                    get_tracer(), trace_id=q.get("trace_id"),
+                    limit=int(q.get("limit", "32")),
+                ))
+            else:
+                self._send_json(200, debug_decode())
+            return
         if self._gate(write=False) is None:
             return
         if self.path == "/apis" or self.path == "/apis/":

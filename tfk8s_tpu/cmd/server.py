@@ -43,6 +43,9 @@ class Server:
         # obs.trace.set_tracer, never per-Server.
         self.tracer = get_tracer()
         self.metrics = Metrics()
+        # span-drop pressure (tail sampling / ring eviction) surfaces on
+        # this server's /metrics as tfk8s_trace_spans_dropped_total
+        self.tracer.set_metrics(self.metrics)
         qps, burst = opts.qps, opts.burst
         if store is not None:
             self.store = store
@@ -142,6 +145,24 @@ class Server:
                             )
                         ]
                     ).encode()
+                    ctype = "application/json"
+                elif path == "/debug/requests":
+                    # zpages view of recently tail-sampled REQUEST traces
+                    # (?trace_id= narrows; the gateway serves the same
+                    # shape with its in-flight table populated)
+                    from tfk8s_tpu.gateway.server import debug_requests
+
+                    body = json.dumps(debug_requests(
+                        server.tracer,
+                        trace_id=query.get("trace_id"),
+                        limit=int(query.get("limit", "32")),
+                    )).encode()
+                    ctype = "application/json"
+                elif path == "/debug/decode":
+                    # live slot/page occupancy per registered replica
+                    from tfk8s_tpu.gateway.server import debug_decode
+
+                    body = json.dumps(debug_decode()).encode()
                     ctype = "application/json"
                 elif path == "/traces":
                     # one JSON object per trace, spans in start order;

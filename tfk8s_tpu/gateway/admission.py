@@ -28,6 +28,7 @@ from typing import Callable, Dict, Optional
 
 from tfk8s_tpu.api.types import TenantPolicy, TenantQuota
 from tfk8s_tpu.client.ratelimit import TokenBucketRateLimiter
+from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.runtime.server import Overloaded, QuotaExceeded
 from tfk8s_tpu.utils.logging import get_logger
 
@@ -105,34 +106,74 @@ class TenantAdmission:
         ``depth`` against its ``limit``, or raise the typed shed
         (Overloaded for pressure, QuotaExceeded for this tenant's own
         budget). Returns the release callable that ends the request's
-        in-flight lease; callers MUST invoke it exactly once."""
+        in-flight lease; callers MUST invoke it exactly once.
+
+        The decision annotates the caller's ambient span (when one is
+        open) with an ``admit``/``shed`` event — a shed request's trace
+        shows exactly WHICH rule turned it away."""
+        span = get_tracer().current_span()
+        priority = 0
+        # unmetered admission (policy disabled) is still an admission
+        # decision — it gets the admit event, just no lease to release
+        release: Callable[[], None] = self._release_noop
+        try:
+            with self._lock:
+                if self._policy.enabled:
+                    state = self._state(tenant)
+                    quota = state.quota
+                    priority = quota.priority
+                    # pressure first (no side effects): the shed threshold
+                    # for this tenant's priority class at the best replica
+                    if limit > 0 and depth >= limit * shed_threshold(quota.priority):
+                        exc = Overloaded(
+                            int(depth) if depth != float("inf") else limit,
+                            limit,
+                            retry_after_s=_overload_retry_after(depth, limit),
+                        )
+                        exc.shed_reason = "priority"
+                        raise exc
+                    if state.bucket is not None:
+                        delay = state.bucket.try_accept_or_delay()
+                        if delay > 0:
+                            raise QuotaExceeded(tenant, delay, reason="qps")
+                    if quota.max_concurrency and state.inflight >= quota.max_concurrency:
+                        raise QuotaExceeded(
+                            tenant,
+                            (1.0 / quota.qps) if quota.qps > 0 else 0.05,
+                            reason="concurrency",
+                        )
+                    state.inflight += 1
+                    release = lambda: self._release(tenant)  # noqa: E731
+        except Overloaded as exc:
+            if span is not None:
+                span.add_event("shed", {
+                    "tenant": tenant, "reason": "priority",
+                    "priority": priority,
+                    "queue_depth": exc.queue_depth,
+                    "retry_after_s": exc.retry_after_s,
+                })
+            raise
+        except QuotaExceeded as exc:
+            if span is not None:
+                span.add_event("shed", {
+                    "tenant": tenant, "reason": exc.reason,
+                    "priority": priority,
+                    "retry_after_s": exc.retry_after_s,
+                })
+            raise
+        if span is not None:
+            span.add_event("admit", {
+                "tenant": tenant, "priority": priority,
+                "queue_depth": depth if depth != float("inf") else -1.0,
+            })
+        return release
+
+    def priority_of(self, tenant: str) -> int:
+        """The tenant's configured priority class (0 when unmetered)."""
         with self._lock:
             if not self._policy.enabled:
-                return self._release_noop
-            state = self._state(tenant)
-            quota = state.quota
-            # pressure first (no side effects): the shed threshold for
-            # this tenant's priority class against the best replica
-            if limit > 0 and depth >= limit * shed_threshold(quota.priority):
-                exc = Overloaded(
-                    int(depth) if depth != float("inf") else limit,
-                    limit,
-                    retry_after_s=_overload_retry_after(depth, limit),
-                )
-                exc.shed_reason = "priority"
-                raise exc
-            if state.bucket is not None:
-                delay = state.bucket.try_accept_or_delay()
-                if delay > 0:
-                    raise QuotaExceeded(tenant, delay, reason="qps")
-            if quota.max_concurrency and state.inflight >= quota.max_concurrency:
-                raise QuotaExceeded(
-                    tenant,
-                    (1.0 / quota.qps) if quota.qps > 0 else 0.05,
-                    reason="concurrency",
-                )
-            state.inflight += 1
-        return lambda: self._release(tenant)
+                return 0
+            return self._quota_for_locked(self._policy, tenant).priority
 
     @staticmethod
     def _release_noop() -> None:

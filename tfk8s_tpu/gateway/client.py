@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 from urllib.parse import urlsplit
 
 from tfk8s_tpu.client.store import NotFound, Unavailable
+from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.runtime.server import (
     DeadlineExceeded,
     InvalidRequest,
@@ -143,12 +144,21 @@ class GatewayClient:
 
     # -- wire ----------------------------------------------------------------
 
-    def _roundtrip(self, body: bytes) -> Tuple[int, Dict[str, str], bytes]:
+    def _roundtrip(self, body: bytes,
+                   traceparent: str = "") -> Tuple[int, Dict[str, str], bytes]:
         """One POST over the warm connection; a connection gone stale
         between requests (server restart, idle FIN) gets ONE fresh-socket
-        retry — the request was never processed, so this is safe."""
-        request = b"%sContent-Length: %d\r\n\r\n%s" % (
-            self._head, len(body), body
+        retry — the request was never processed, so this is safe.
+
+        ``traceparent`` (per-request — each attempt carries its own span
+        context) rides as the W3C header between the invariant prefix and
+        the framing."""
+        tp = (
+            f"traceparent: {traceparent}\r\n".encode("ascii")
+            if traceparent else b""
+        )
+        request = b"%s%sContent-Length: %d\r\n\r\n%s" % (
+            self._head, tp, len(body), body
         )
         for attempt in (0, 1):
             sock, reader = self._conn()
@@ -195,41 +205,63 @@ class GatewayClient:
 
     def request(self, payload: Any, timeout: float = 30.0) -> Any:
         """Submit one request through the gateway; retries shed (429)
-        responses with jittered backoff inside ``timeout`` seconds."""
+        responses with jittered backoff inside ``timeout`` seconds.
+
+        The whole exchange (every retry included) rides ONE
+        ``gateway.client.request`` span whose context crosses the wire as
+        the ``traceparent`` header — the server continues the trace, so
+        client, gateway, and decode loop share one trace id. Retries
+        annotate the span with typed ``retry`` events."""
         deadline = time.monotonic() + timeout
         shed_backoff = self.OVERLOAD_BACKOFF_S
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise DeadlineExceeded(
-                    f"gateway request deadline ({timeout}s) exceeded"
+        attempt = 0
+        with get_tracer().start_span(
+            "gateway.client.request",
+            attributes={"path": self._path, "tenant": self.tenant},
+        ) as span:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceeded(
+                        f"gateway request deadline ({timeout}s) exceeded"
+                    )
+                body = json.dumps(
+                    {"payload": payload, "timeoutS": remaining}
+                ).encode()
+                attempt += 1
+                try:
+                    status, headers, data = self._roundtrip(
+                        body, traceparent=span.traceparent
+                    )
+                except OSError as exc:
+                    raise Unavailable(f"gateway unreachable: {exc}") from exc
+                if status == 200:
+                    span.set_attribute("http.status_code", 200)
+                    return json.loads(data)["result"]
+                try:
+                    envelope = json.loads(data)
+                except ValueError:
+                    envelope = {}
+                err = _map_error(
+                    status,
+                    str(envelope.get("reason", "")),
+                    str(envelope.get("message", data[:200])),
+                    envelope.get("details") or {},
+                    _parse_retry_after(
+                        {k.lower(): v for k, v in headers.items()}.get("retry-after")
+                    ),
                 )
-            body = json.dumps(
-                {"payload": payload, "timeoutS": remaining}
-            ).encode()
-            try:
-                status, headers, data = self._roundtrip(body)
-            except OSError as exc:
-                raise Unavailable(f"gateway unreachable: {exc}") from exc
-            if status == 200:
-                return json.loads(data)["result"]
-            try:
-                envelope = json.loads(data)
-            except ValueError:
-                envelope = {}
-            err = _map_error(
-                status,
-                str(envelope.get("reason", "")),
-                str(envelope.get("message", data[:200])),
-                envelope.get("details") or {},
-                _parse_retry_after(
-                    {k.lower(): v for k, v in headers.items()}.get("retry-after")
-                ),
-            )
-            if isinstance(err, (Overloaded, QuotaExceeded)):
-                delay = jittered_backoff(err.retry_after_s, shed_backoff)
-                if delay < deadline - time.monotonic():
-                    time.sleep(delay)
-                    shed_backoff = min(shed_backoff * 2, 1.0)
-                    continue
-            raise err
+                if isinstance(err, (Overloaded, QuotaExceeded)):
+                    delay = jittered_backoff(err.retry_after_s, shed_backoff)
+                    if delay < deadline - time.monotonic():
+                        span.add_event("retry", {
+                            "attempt": attempt,
+                            "reason": type(err).__name__,
+                            "status": status,
+                            "backoff_s": delay,
+                        })
+                        time.sleep(delay)
+                        shed_backoff = min(shed_backoff * 2, 1.0)
+                        continue
+                span.set_attribute("http.status_code", status)
+                raise err

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.trainer.serve_controller import EMA_ALPHA
 from tfk8s_tpu.utils.logging import get_logger
 
@@ -156,7 +157,13 @@ class RouteTable:
                     best, best_depth = key, d
             if best is not None:
                 self._inflight[best] = self._inflight.get(best, 0) + 1
-            return best
+        if best is not None:
+            span = get_tracer().current_span()
+            if span is not None:
+                span.add_event("route.pick", {
+                    "replica": best, "effective_depth": best_depth,
+                })
+        return best
 
     def release(self, key: str) -> None:
         with self._lock:

@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from tfk8s_tpu.client.store import NotFound, Unavailable
 from tfk8s_tpu.gateway.admission import TenantAdmission
 from tfk8s_tpu.gateway.router import RouteTable
+from tfk8s_tpu.obs.trace import TailSampler, get_tracer, recent_request_traces
 from tfk8s_tpu.runtime import server as serving
 from tfk8s_tpu.runtime.server import (
     DeadlineExceeded,
@@ -110,6 +111,36 @@ def _wire_error(exc: Exception) -> Tuple[int, str, Dict[str, Any], Dict[str, str
     # Draining should be absorbed by the dispatch loop; RequestFailed and
     # any other ServeError are the model's failure, a plain 500
     return 500, "RequestFailed", {}, headers
+
+
+def debug_requests(tracer, inflight: Optional[list] = None,
+                   trace_id: Optional[str] = None,
+                   limit: int = 32) -> Dict[str, Any]:
+    """The ``/debug/requests`` zpage body: in-flight requests plus the
+    recently tail-sampled request timelines — one shape shared by the
+    gateway, the apiserver, and the operator server."""
+    return {
+        "inflight": list(inflight or []),
+        "recent": recent_request_traces(
+            tracer, trace_id=trace_id, limit=limit
+        ),
+        "spans_dropped": dict(tracer.dropped),
+    }
+
+
+def debug_decode() -> Dict[str, Any]:
+    """The ``/debug/decode`` zpage body: live slot/page occupancy per
+    registered replica (decode loops report slots; batchers their
+    queue)."""
+    replicas: Dict[str, Any] = {}
+    for key in serving.replica_keys():
+        server = serving.lookup_replica(key)
+        if server is None:
+            continue
+        state_fn = getattr(server, "debug_state", None)
+        if state_fn is not None:
+            replicas[key] = state_fn()
+    return {"replicas": replicas}
 
 
 class _LeanHeaders(dict):
@@ -219,6 +250,20 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._send_json(200, {"status": "ok"})
             return
+        path, _, query = self.path.partition("?")
+        if path == "/debug/requests":
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv
+            )
+            self._send_json(200, debug_requests(
+                get_tracer(), self.server.inflight_snapshot(),
+                trace_id=params.get("trace_id"),
+                limit=int(params.get("limit", "32")),
+            ))
+            return
+        if path == "/debug/decode":
+            self._send_json(200, debug_decode())
+            return
         self._send_status_error(404, "NotFound", self.path)
 
     def do_POST(self) -> None:
@@ -241,22 +286,43 @@ class _Handler(BaseHTTPRequestHandler):
         result = None
         err: Optional[Exception] = None
         code = 200
+        # the request's ROOT span: continues the client's traceparent
+        # header and anchors the trace's tail-sampling verdict at its end
+        tracer = get_tracer()
+        span = tracer.start_span(
+            "gateway.request",
+            traceparent=self.headers.get("traceparent"),
+            attributes={"serve": serve_label, "tenant": tenant},
+            tail_sample=True,
+        )
+        self.server.track_inflight(span, serve_label, tenant)
         try:
-            result = self.server.dispatch(
-                namespace, name, tenant, body.get("payload"), timeout
-            )
-        except Exception as exc:  # noqa: BLE001 - mapped to typed wire errors
-            err = exc
-            code, reason, details, headers = _wire_error(exc)
-            if not isinstance(exc, (ServeError, NotFound, Unavailable)):
-                log.warning("gateway 500 on %s: %s", serve_label, exc)
+            with span:
+                try:
+                    result = self.server.dispatch(
+                        namespace, name, tenant, body.get("payload"), timeout
+                    )
+                except Exception as exc:  # noqa: BLE001 - typed wire errors
+                    err = exc
+                    code, reason, details, headers = _wire_error(exc)
+                    if not isinstance(exc, (ServeError, NotFound, Unavailable)):
+                        log.warning("gateway 500 on %s: %s", serve_label, exc)
+                span.set_attribute("http.status_code", code)
+                if err is not None:
+                    span.set_status("error", f"{reason}: {err}")
+        finally:
+            self.server.untrack_inflight(span)
         # metrics land BEFORE the response bytes: a caller observing its
         # own 200 must find the series already incremented
         if m is not None:
             labels = {"serve": serve_label, "tenant": tenant}
+            # exemplar only when the tail sampler KEPT the trace — a
+            # bucket must never link to a trace that was dropped
+            kept = tracer.verdict(span.trace_id) is True
             m.observe(
                 "tfk8s_gateway_request_seconds",
                 time.perf_counter() - t0, labels,
+                exemplar=span.trace_id if kept else None,
             )
             m.inc("tfk8s_gateway_requests_total", 1.0,
                   {**labels, "code": str(code)})
@@ -328,6 +394,17 @@ class GatewayServer(ThreadingHTTPServer):
         self.stopping = threading.Event()
         self._states: Dict[Tuple[str, str], _ServeState] = {}
         self._states_lock = threading.Lock()
+        # request-scoped tracing: install a tail sampler on the process
+        # tracer (request roots only — control-plane spans bypass it) and
+        # wire the drop counter into this gateway's registry
+        tracer = get_tracer()
+        if tracer.sampler is None:
+            tracer.set_sampler(TailSampler())
+        if metrics is not None:
+            tracer.set_metrics(metrics)
+        # in-flight request table for /debug/requests (span id -> row)
+        self._inflight: Dict[str, Dict[str, Any]] = {}
+        self._inflight_lock = threading.Lock()
         # route tables learn of drains the instant replicas unregister
         self._drain_hook: Callable[[str], None] = self._on_drain
         serving.add_drain_hook(self._drain_hook)
@@ -359,6 +436,34 @@ class GatewayServer(ThreadingHTTPServer):
             tables = [s.table for s in self._states.values()]
         for table in tables:
             table.mark_draining(key)
+
+    # -- /debug/requests in-flight table -------------------------------------
+
+    def track_inflight(self, span, serve: str, tenant: str) -> None:
+        if not span.span_id:
+            return  # tracing disabled: _NoopSpan
+        with self._inflight_lock:
+            self._inflight[span.span_id] = {
+                "trace_id": span.trace_id,
+                "span_id": span.span_id,
+                "serve": serve,
+                "tenant": tenant,
+                "start_time": span.start_time,
+            }
+
+    def untrack_inflight(self, span) -> None:
+        if not span.span_id:
+            return
+        with self._inflight_lock:
+            self._inflight.pop(span.span_id, None)
+
+    def inflight_snapshot(self) -> list:
+        now = time.time()
+        with self._inflight_lock:
+            rows = [dict(r) for r in self._inflight.values()]
+        for r in rows:
+            r["age_s"] = now - r["start_time"]
+        return sorted(rows, key=lambda r: r["start_time"])
 
     # -- request path --------------------------------------------------------
 
@@ -395,6 +500,12 @@ class GatewayServer(ThreadingHTTPServer):
         state = self.state_for(namespace, name)
         deadline = time.monotonic() + timeout
         t0 = time.perf_counter()
+        # the handler's root span is ambient on this thread; its context
+        # rides into the replica submit so the decode loop's timeline
+        # lands in the SAME trace
+        span = get_tracer().current_span()
+        traceparent = span.traceparent if span is not None else None
+        priority = state.admission.priority_of(tenant)
         release = state.admission.admit(
             tenant, state.table.least_depth(), state.queue_limit
         )
@@ -434,10 +545,18 @@ class GatewayServer(ThreadingHTTPServer):
                             time.perf_counter() - t0,
                             {"serve": f"{namespace}/{name}"},
                         )
-                    return server.submit(payload, timeout=remaining)
+                    return server.submit(
+                        payload, timeout=remaining, traceparent=traceparent,
+                        tenant=tenant, priority=priority,
+                    )
                 except Draining:
                     # rolling out from under us — retry the next-least-
                     # loaded replica (the zero-failed-request contract)
+                    if span is not None:
+                        span.add_event("retry", {
+                            "reason": "Draining", "replica": key,
+                            "backoff_s": 0.0,
+                        })
                     exclude.add(key)
                     continue
                 finally:

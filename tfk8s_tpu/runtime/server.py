@@ -47,10 +47,22 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tfk8s_tpu.obs import trace as _trace
 from tfk8s_tpu.runtime import progress as _progress
 from tfk8s_tpu.utils.logging import Metrics, get_logger
 
 log = get_logger("serve")
+
+# Per-token timeline events attached to a traced request's serve span
+# are strided down to this many samples — a 4k-token generation must
+# not balloon its span (the full TPOT distribution is in the histogram;
+# the span carries the shape).
+MAX_TOKEN_EVENTS = 32
+
+
+def _trace_id_of(traceparent: str) -> str:
+    parsed = _trace.parse_traceparent(traceparent)
+    return parsed[0] if parsed else ""
 
 
 class ServeError(Exception):
@@ -505,6 +517,20 @@ class _GenRequest:
     dequeue_t: float = 0.0       # admission into a slot
     first_token_t: float = 0.0   # prefill produced the first output token
     out: List[int] = field(default_factory=list)
+    # request-scoped observability (empty traceparent = untraced; the
+    # timeline below is only collected for traced requests)
+    traceparent: str = ""
+    tenant: str = ""
+    priority: int = 0
+    wall_start: float = 0.0      # time.time() at submit, anchors the
+    # perf_counter timeline onto the wall clock spans use
+    cached_pages: int = 0        # prefix-cache pages reused at admission
+    prefill_chunks: int = 0      # chunk rounds this request rode
+    token_times: List[float] = field(default_factory=list)
+
+    def wall(self, t: float) -> float:
+        """Map a perf_counter stamp onto the wall clock."""
+        return self.wall_start + (t - self.enqueue_t)
 
 
 @dataclass(eq=False)
@@ -593,7 +619,11 @@ class DecodeLoopExecutor:
             ("tfk8s_serving_tokens_total",
              "Generated tokens, counted per decode iteration."),
             ("tfk8s_serving_tpot_seconds",
-             "Per-request mean time per output token (decode phase)."),
+             "Per-request mean time per output token (decode phase), "
+             "by tenant and priority class."),
+            ("tfk8s_serving_ttft_seconds",
+             "Per-request time to first token (submit to first output), "
+             "by tenant and priority class."),
             ("tfk8s_serving_slot_occupancy",
              "Live decode slots / slot capacity of the decode loop."),
             ("tfk8s_serving_page_occupancy",
@@ -653,11 +683,16 @@ class DecodeLoopExecutor:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, payload: Any, timeout: Optional[float] = 30.0) -> Any:
+    def submit(self, payload: Any, timeout: Optional[float] = 30.0,
+               traceparent: Optional[str] = None, tenant: str = "",
+               priority: int = 0) -> Any:
         """Blocking request; raises Overloaded / Draining / InvalidRequest
         / RequestFailed / DeadlineExceeded — the :class:`ModelServer`
         contract. Returns ``{"tokens": [...], "version": ...}`` with the
-        generated continuation (ending at eos or the budget)."""
+        generated continuation (ending at eos or the budget). A
+        ``traceparent`` makes the request TRACED: the loop collects its
+        per-token timeline and retires it as a ``serve.request`` span
+        under that parent; tenant/priority label its TTFT/TPOT."""
         try:
             tokens, gen = self.model.validate(payload)
         except InvalidRequest:
@@ -667,7 +702,9 @@ class DecodeLoopExecutor:
             )
             raise
         req = _GenRequest(
-            tokens=tokens, gen_budget=gen, enqueue_t=time.perf_counter()
+            tokens=tokens, gen_budget=gen, enqueue_t=time.perf_counter(),
+            traceparent=traceparent or "", tenant=tenant,
+            priority=int(priority), wall_start=time.time(),
         )
         with self._cond:
             if self._draining or self._stopped:
@@ -685,9 +722,11 @@ class DecodeLoopExecutor:
             )
             self._cond.notify_all()
         if not req.done.wait(timeout):
+            timed_out = False
             with self._cond:
                 try:
                     self._q.remove(req)
+                    timed_out = True
                     self.metrics.inc(
                         "tfk8s_serving_requests_total", 1.0,
                         {**self.labels, "outcome": "timeout"},
@@ -698,6 +737,14 @@ class DecodeLoopExecutor:
                     )
                 except ValueError:
                     pass  # already admitted into a slot; it will finish
+            if timed_out and req.traceparent:
+                _trace.get_tracer().record_span(
+                    "serve.request", req.wall_start, time.time(),
+                    traceparent=req.traceparent, status="error",
+                    attributes={"outcome": "timeout",
+                                "tenant": req.tenant,
+                                "priority": req.priority},
+                )
             raise DeadlineExceeded(f"request not served within {timeout}s")
         if req.error is not None:
             raise RequestFailed(str(req.error)) from req.error
@@ -724,6 +771,7 @@ class DecodeLoopExecutor:
                 self.metrics.inc(
                     "tfk8s_serving_prefix_cache_hits_total", 1.0, self.labels
                 )
+            req.cached_pages = lease.cached_pages
             req.dequeue_t = time.perf_counter()
             idx = self._slots.index(None)
             slot = _Slot(req=req, lease=lease, idx=idx)
@@ -796,6 +844,7 @@ class DecodeLoopExecutor:
             finishing: List[Tuple[_Slot, int, int]] = []
             for entry in pending:
                 slot, base = entry
+                slot.req.prefill_chunks += 1
                 tokens, plen = slot.req.tokens, len(slot.req.tokens)
                 end = min(base + chunk_len, plen)
                 self._pages_for(slot, end)
@@ -878,12 +927,15 @@ class DecodeLoopExecutor:
             "tfk8s_serving_batch_occupancy", self.mean_batch_occupancy,
             self.labels,
         )
+        step_t = time.perf_counter()  # one stamp per step, shared by rows
         for i in live:
             slot = self._slots[i]
             tok = int(nxt[i])
             slot.position += 1
             slot.last_token = tok
             slot.req.out.append(tok)
+            if slot.req.traceparent:
+                slot.req.token_times.append(step_t)
             if len(slot.req.out) >= slot.req.gen_budget or (
                 self.model.eos_id is not None and tok == self.model.eos_id
             ):
@@ -900,6 +952,10 @@ class DecodeLoopExecutor:
             self._live -= 1
             self.served_total += 1
             self._state_dirty = True  # the freed row must stop stepping
+        # exemplars attach OPTIMISTICALLY here (the tail verdict isn't in
+        # yet): slow/error traces — the ones behind interesting buckets —
+        # are always kept, so a high-bucket exemplar stays resolvable
+        trace_id = _trace_id_of(req.traceparent)
         self.metrics.inc(
             "tfk8s_serving_requests_total", 1.0,
             {**self.labels, "outcome": "ok"},
@@ -912,16 +968,100 @@ class DecodeLoopExecutor:
             "tfk8s_serving_execute_seconds", now - req.dequeue_t, self.labels
         )
         self.metrics.observe(
-            "tfk8s_serving_request_seconds", now - req.enqueue_t, self.labels
+            "tfk8s_serving_request_seconds", now - req.enqueue_t, self.labels,
+            exemplar=trace_id,
         )
+        class_labels = {
+            **self.labels, "tenant": req.tenant,
+            "priority": str(req.priority),
+        }
+        if req.first_token_t:
+            self.metrics.observe(
+                "tfk8s_serving_ttft_seconds",
+                req.first_token_t - req.enqueue_t, class_labels,
+                exemplar=trace_id,
+            )
         if len(req.out) > 1:
             self.metrics.observe(
                 "tfk8s_serving_tpot_seconds",
                 (now - req.first_token_t) / (len(req.out) - 1),
-                self.labels,
+                class_labels, exemplar=trace_id,
             )
-        req.result = {"tokens": list(req.out), "version": self.model.version}
+        if req.traceparent:
+            self._emit_request_span(req, now)
+        req.result = {
+            "tokens": list(req.out), "version": self.model.version,
+            # first-token latency rides the reply so callers (and the
+            # bench) get exact per-request TTFT without scraping buckets
+            "ttft_s": round(req.first_token_t - req.enqueue_t, 6)
+            if req.first_token_t else None,
+        }
         req.done.set()
+
+    def _retire_reason(self, req: _GenRequest) -> str:
+        if (
+            self.model.eos_id is not None and req.out
+            and req.out[-1] == self.model.eos_id
+        ):
+            return "eos"
+        return "budget"
+
+    def _emit_request_span(
+        self, req: _GenRequest, end_t: float, error: Optional[str] = None
+    ) -> None:
+        """The per-request timeline, attached as one ``serve.request``
+        span under the caller's traceparent: admission wait, prefix-cache
+        reuse, prefill chunking, TTFT, a strided sample of per-token
+        TPOTs, and the retirement reason."""
+        reason = "error" if error is not None else self._retire_reason(req)
+        events: List[Dict[str, Any]] = []
+        if req.dequeue_t:
+            events.append({
+                "name": "admitted", "ts": req.wall(req.dequeue_t),
+                "attributes": {
+                    "queue_wait_s": req.dequeue_t - req.enqueue_t,
+                    "cached_pages": req.cached_pages,
+                },
+            })
+        if req.first_token_t:
+            events.append({
+                "name": "first_token", "ts": req.wall(req.first_token_t),
+                "attributes": {
+                    "ttft_s": req.first_token_t - req.enqueue_t,
+                    "prefill_chunks": req.prefill_chunks,
+                },
+            })
+        times = req.token_times
+        if times:
+            stride = max(1, len(times) // MAX_TOKEN_EVENTS)
+            prev = req.first_token_t or times[0]
+            for i, t in enumerate(times):
+                if i % stride == 0 or i == len(times) - 1:
+                    events.append({
+                        "name": "token", "ts": req.wall(t),
+                        "attributes": {"i": i + 1, "tpot_s": t - prev},
+                    })
+                prev = t
+        events.append({
+            "name": "retire", "ts": req.wall(end_t),
+            "attributes": {"reason": reason, "tokens": len(req.out)},
+        })
+        _trace.get_tracer().record_span(
+            "serve.request", req.wall_start, req.wall(end_t),
+            traceparent=req.traceparent,
+            status="error" if error is not None else "ok",
+            attributes={
+                "outcome": reason,
+                "tenant": req.tenant,
+                "priority": req.priority,
+                "prompt_tokens": len(req.tokens),
+                "tokens_out": len(req.out),
+                "cached_pages": req.cached_pages,
+                "prefill_chunks": req.prefill_chunks,
+                **({"error": error} if error is not None else {}),
+            },
+            events=events,
+        )
 
     def _fail_all(self, e: BaseException) -> None:
         """A device-step failure poisons every in-flight request (the
@@ -939,8 +1079,11 @@ class DecodeLoopExecutor:
                 {**self.labels, "outcome": "error"},
             )
             log.warning("decode loop failed %d request(s): %s", len(victims), e)
+        now = time.perf_counter()
         for slot in victims:
             slot.req.error = e
+            if slot.req.traceparent:
+                self._emit_request_span(slot.req, now, error=str(e))
             slot.req.done.set()
 
     def _update_occupancy_gauges(self) -> None:
@@ -953,6 +1096,41 @@ class DecodeLoopExecutor:
             self.allocator.used_pages / max(self.allocator.num_pages - 1, 1),
             self.labels,
         )
+
+    # -- live introspection (/debug/decode) ---------------------------------
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Zpages view of the loop RIGHT NOW: per-slot occupancy (row
+        position, page count, progress, owner) and page-pool pressure —
+        what ``/debug/decode`` renders per replica."""
+        with self._cond:
+            slots: List[Optional[Dict[str, Any]]] = []
+            for slot in self._slots:
+                if slot is None:
+                    slots.append(None)
+                    continue
+                req = slot.req
+                slots.append({
+                    "position": slot.position,
+                    "pages": len(slot.lease.pages),
+                    "prompt_tokens": len(req.tokens),
+                    "tokens_out": len(req.out),
+                    "gen_budget": req.gen_budget,
+                    "tenant": req.tenant,
+                    "priority": req.priority,
+                    "trace_id": _trace_id_of(req.traceparent),
+                })
+            return {
+                "kind": "decode_loop",
+                "queue_depth": len(self._q),
+                "live_slots": self._live,
+                "slot_capacity": len(self._slots),
+                "slots": slots,
+                "pages_used": self.allocator.used_pages,
+                "pages_total": self.allocator.num_pages,
+                "served_total": self.served_total,
+                "tokens_total": self.tokens_total,
+            }
 
     # -- load reporting (progress → pod status → autoscaler) ----------------
 
@@ -1038,6 +1216,11 @@ class _Request:
     error: Optional[BaseException] = None
     # stamped at dispatch so queue/execute split exactly once per request
     dequeue_t: float = 0.0
+    # request-scoped observability (empty traceparent = untraced)
+    traceparent: str = ""
+    tenant: str = ""
+    priority: int = 0
+    wall_start: float = 0.0
 
 
 class ModelServer:
@@ -1137,10 +1320,14 @@ class ModelServer:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, payload: Any, timeout: Optional[float] = 30.0) -> Any:
+    def submit(self, payload: Any, timeout: Optional[float] = 30.0,
+               traceparent: Optional[str] = None, tenant: str = "",
+               priority: int = 0) -> Any:
         """Blocking request: returns the model's response for ``payload``,
         or raises Overloaded / Draining / InvalidRequest / RequestFailed /
-        DeadlineExceeded (a TimeoutError subclass)."""
+        DeadlineExceeded (a TimeoutError subclass). A ``traceparent``
+        makes the request traced: its served interval lands as a
+        ``serve.request`` span under that parent."""
         try:
             bucket = self.model.bucket_of(payload)  # TypeError: bad payload
         except InvalidRequest:
@@ -1152,7 +1339,11 @@ class ModelServer:
                 {**self.labels, "outcome": "invalid"},
             )
             raise
-        req = _Request(payload=payload, bucket=bucket, enqueue_t=time.perf_counter())
+        req = _Request(
+            payload=payload, bucket=bucket, enqueue_t=time.perf_counter(),
+            traceparent=traceparent or "", tenant=tenant,
+            priority=int(priority), wall_start=time.time(),
+        )
         with self._cond:
             if self._draining or self._stopped:
                 raise Draining("replica is draining; retry another replica")
@@ -1173,9 +1364,11 @@ class ModelServer:
             # (the batcher never burns a forward on a caller that gave
             # up, and it is counted timeout, not ok); one already riding
             # a dispatched batch completes server-side — bounded waste.
+            timed_out = False
             with self._cond:
                 try:
                     self._q.remove(req)
+                    timed_out = True
                     self.metrics.inc(
                         "tfk8s_serving_requests_total", 1.0,
                         {**self.labels, "outcome": "timeout"},
@@ -1186,6 +1379,14 @@ class ModelServer:
                     )
                 except ValueError:
                     pass  # already dequeued into a batch
+            if timed_out and req.traceparent:
+                _trace.get_tracer().record_span(
+                    "serve.request", req.wall_start, time.time(),
+                    traceparent=req.traceparent, status="error",
+                    attributes={"outcome": "timeout",
+                                "tenant": req.tenant,
+                                "priority": req.priority},
+                )
             raise DeadlineExceeded(f"request not served within {timeout}s")
         if req.error is not None:
             raise RequestFailed(str(req.error)) from req.error
@@ -1253,6 +1454,15 @@ class ModelServer:
             t1 = time.perf_counter()
             for r in batch:
                 r.error = e
+                if r.traceparent:
+                    _trace.get_tracer().record_span(
+                        "serve.request", r.wall_start,
+                        r.wall_start + (t1 - r.enqueue_t),
+                        traceparent=r.traceparent, status="error",
+                        attributes={"outcome": "error", "error": str(e),
+                                    "tenant": r.tenant,
+                                    "priority": r.priority},
+                    )
                 r.done.set()
             self.metrics.inc(
                 "tfk8s_serving_requests_total", float(len(batch)),
@@ -1280,10 +1490,45 @@ class ModelServer:
             )
             self.metrics.observe("tfk8s_serving_execute_seconds", exec_s, self.labels)
             self.metrics.observe(
-                "tfk8s_serving_request_seconds", t1 - r.enqueue_t, self.labels
+                "tfk8s_serving_request_seconds", t1 - r.enqueue_t, self.labels,
+                exemplar=_trace_id_of(r.traceparent),
             )
+            if r.traceparent:
+                _trace.get_tracer().record_span(
+                    "serve.request", r.wall_start,
+                    r.wall_start + (t1 - r.enqueue_t),
+                    traceparent=r.traceparent,
+                    attributes={
+                        "outcome": "ok",
+                        "tenant": r.tenant,
+                        "priority": r.priority,
+                        "batch_size": len(batch),
+                    },
+                    events=[{
+                        "name": "dispatched",
+                        "ts": r.wall_start + (r.dequeue_t - r.enqueue_t),
+                        "attributes": {
+                            "queue_wait_s": r.dequeue_t - r.enqueue_t,
+                            "execute_s": exec_s,
+                        },
+                    }],
+                )
             r.result = res
             r.done.set()
+
+    # -- live introspection (/debug/decode) ---------------------------------
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Zpages view of the batcher (no slots/pages here — the shape
+        ``/debug/decode`` renders for a non-generative replica)."""
+        with self._cond:
+            return {
+                "kind": "batch",
+                "queue_depth": len(self._q),
+                "served_total": self.served_total,
+                "batches_total": self.batches_total,
+                "rejected_total": self.rejected_total,
+            }
 
     # -- load reporting (progress → pod status → autoscaler) ----------------
 
@@ -1348,6 +1593,12 @@ def unregister_replica(key: str) -> None:
 def lookup_replica(key: str) -> Optional[Any]:
     with _registry_lock:
         return _REPLICAS.get(key)
+
+
+def replica_keys() -> List[str]:
+    """Every registered replica key (the /debug/decode enumeration)."""
+    with _registry_lock:
+        return sorted(_REPLICAS)
 
 
 # How often the serving entrypoint refreshes its progress report. The
@@ -1502,11 +1753,20 @@ class ServeClient:
             self._cache = (time.monotonic(), keys)
         return keys
 
-    def request(self, payload: Any, timeout: float = 30.0) -> Any:
+    def request(self, payload: Any, timeout: float = 30.0,
+                traceparent: Optional[str] = None, tenant: str = "",
+                priority: int = 0) -> Any:
         deadline = time.monotonic() + timeout
         refresh = False
         backoff = 0.02
         shed_backoff = self.OVERLOAD_BACKOFF_S
+        attempt = 0
+        # the ambient span (or the one the traceparent continues) carries
+        # the retry timeline: a request retried through a Draining replica
+        # shows its FULL path, not just the winning attempt
+        span = _trace.get_tracer().current_span()
+        if traceparent is None and span is not None:
+            traceparent = span.traceparent
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -1533,10 +1793,19 @@ class ServeClient:
             if server is None:
                 refresh = True
                 continue
+            attempt += 1
             try:
-                return server.submit(payload, timeout=remaining)
+                return server.submit(
+                    payload, timeout=remaining, traceparent=traceparent,
+                    tenant=tenant, priority=priority,
+                )
             except Draining:
                 # replica is rolling out from under us — retry elsewhere
+                if span is not None:
+                    span.add_event("retry", {
+                        "attempt": attempt, "reason": "Draining",
+                        "replica": key, "backoff_s": 0.0,
+                    })
                 refresh = True
                 continue
             except Overloaded as exc:
@@ -1545,6 +1814,11 @@ class ServeClient:
                     # the deadline can't absorb the backoff — surface the
                     # shed rather than burn the wait and time out anyway
                     raise
+                if span is not None:
+                    span.add_event("retry", {
+                        "attempt": attempt, "reason": "Overloaded",
+                        "replica": key, "backoff_s": delay,
+                    })
                 time.sleep(delay)
                 shed_backoff = min(shed_backoff * 2, 1.0)
                 refresh = True
@@ -1591,6 +1865,7 @@ __all__ = [
     "register_replica",
     "remove_drain_hook",
     "replica_is_ready",
+    "replica_keys",
     "serve",
     "set_metrics",
     "template_hash",

@@ -71,7 +71,7 @@ from tfk8s_tpu.client.informer import ResourceEventHandler, SharedIndexInformer
 from tfk8s_tpu.client.listers import Lister
 from tfk8s_tpu.client.store import Conflict, NotFound
 from tfk8s_tpu.controller.controller import Controller
-from tfk8s_tpu.obs.trace import Tracer, get_tracer
+from tfk8s_tpu.obs.trace import TRACEPARENT_ENV, Tracer, get_tracer
 from tfk8s_tpu.runtime.server import template_hash
 from tfk8s_tpu.trainer import labels as L
 from tfk8s_tpu.utils.logging import EventRecorder, Metrics, get_logger
@@ -352,7 +352,16 @@ class TPUServeController:
                 continue
             if len(live) + len(to_create) >= ceiling:
                 break
-            to_create.append(render_serve_pod(serve, version, i))
+            pod = render_serve_pod(serve, version, i)
+            with self.tracer.start_span(
+                "pod.create", attributes={"pod": pod.metadata.key}
+            ) as sp:
+                # same control->data plane handoff as the trainer: the
+                # replica's kubelet/entrypoint spans continue THIS trace,
+                # so a rollout reads as one tree from CRD edit to Ready
+                if sp.traceparent and pod.spec.containers:
+                    pod.spec.containers[0].env[TRACEPARENT_ENV] = sp.traceparent
+            to_create.append(pod)
         if to_create:
             created = self.cs.pods(ns).create_many(to_create)
             if created:

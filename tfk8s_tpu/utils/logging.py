@@ -12,7 +12,7 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
 _configured = False
@@ -197,6 +197,10 @@ class Metrics:
         # series key -> [bucket counts..., +inf count], plus _sum
         self._hist_counts: Dict[Any, List[float]] = {}
         self._hist_sum: Dict[Any, float] = {}
+        # (series key, bucket index) -> (trace_id, observed value): the
+        # most recent exemplar per bucket, linking the bucket to a kept
+        # trace in the exposition (OpenMetrics-style " # {...}" suffix)
+        self._hist_exemplars: Dict[Any, Dict[int, Tuple[str, float]]] = {}
         self._help: Dict[str, str] = {}
 
     # -- write side --------------------------------------------------------
@@ -225,8 +229,13 @@ class Metrics:
     def observe(
         self, name: str, value: float,
         labels: Optional[Dict[str, str]] = None,
+        exemplar: Optional[str] = None,
     ) -> None:
-        """Record one histogram observation (e.g. a sync latency)."""
+        """Record one histogram observation (e.g. a sync latency).
+        ``exemplar`` is a trace id to pin on the bucket the observation
+        lands in — exposition renders it as the OpenMetrics
+        ``# {trace_id="..."} value`` suffix so a slow bucket links to a
+        kept trace. Last writer per bucket wins."""
         key = _series_key(name, labels)
         with self._lock:
             counts = self._hist_counts.setdefault(
@@ -235,10 +244,16 @@ class Metrics:
             for i, ub in enumerate(_DEFAULT_BUCKETS):
                 if value <= ub:
                     counts[i] += 1
+                    bucket = i
                     break
             else:
                 counts[-1] += 1
+                bucket = len(_DEFAULT_BUCKETS)
             self._hist_sum[key] = self._hist_sum.get(key, 0.0) + value
+            if exemplar:
+                self._hist_exemplars.setdefault(key, {})[bucket] = (
+                    exemplar, value,
+                )
 
     # -- read side ---------------------------------------------------------
 
@@ -263,13 +278,19 @@ class Metrics:
         removed = 0
         with self._lock:
             for table in (
-                self._counters, self._gauges, self._hist_counts, self._hist_sum,
+                self._counters, self._gauges, self._hist_counts,
+                self._hist_sum, self._hist_exemplars,
             ):
                 doomed = [k for k in table if want.issubset(set(k[1]))]
                 for k in doomed:
                     del table[k]
-                # _hist_sum shares keys with _hist_counts; one series each
-                if table is not self._hist_sum:
+                # _hist_sum/_hist_exemplars share keys with _hist_counts;
+                # one series each (identity, not ==: two empty tables
+                # compare equal and would double-count)
+                if any(
+                    table is t for t in
+                    (self._counters, self._gauges, self._hist_counts)
+                ):
                     removed += len(doomed)
         return removed
 
@@ -328,14 +349,28 @@ class Metrics:
             ):
                 n = _sanitize_name(name)
                 header(name, n, "histogram")
+                exemplars = self._hist_exemplars.get((name, lk), {})
+
+                def _ex(bucket: int) -> str:
+                    ex = exemplars.get(bucket)
+                    if ex is None:
+                        return ""
+                    tid, val = ex
+                    return f' # {{trace_id="{_escape_label_value(tid)}"}} {val}'
+
                 cum = 0.0
                 for i, ub in enumerate(_DEFAULT_BUCKETS):
                     cum += counts[i]
                     le = 'le="{}"'.format(ub)
-                    lines.append(f"{n}_bucket{_render_labels(lk, le)} {cum}")
+                    lines.append(
+                        f"{n}_bucket{_render_labels(lk, le)} {cum}{_ex(i)}"
+                    )
                 cum += counts[-1]
                 inf = 'le="+Inf"'
-                lines.append(f"{n}_bucket{_render_labels(lk, inf)} {cum}")
+                lines.append(
+                    f"{n}_bucket{_render_labels(lk, inf)} {cum}"
+                    f"{_ex(len(_DEFAULT_BUCKETS))}"
+                )
                 lines.append(
                     f"{n}_sum{_render_labels(lk)} {self._hist_sum.get((name, lk), 0.0)}"
                 )

@@ -19,6 +19,13 @@ every literal metric name against the conventions the build exposes on
   why a placeholder in the FINAL name segment of a counter/histogram
   still has to satisfy the suffix rule through the literal tail.
 
+Beyond the static source scan, ``lint_exposition`` validates rendered
+/metrics text — every sample line must parse, and the OpenMetrics-style
+exemplar suffix (`` # {trace_id="..."} <value>``, emitted by
+``Metrics.observe(..., exemplar=...)``) is legal ONLY on ``_bucket``
+lines: exemplars anchor a histogram observation to the trace that
+produced it, and nothing else carries one.
+
 Wired into the tier-1 suite by tests/test_metric_names.py; also runnable
 standalone: ``python tools/check_metric_names.py [paths...]`` exits 1 and
 prints one line per violation.
@@ -71,6 +78,38 @@ def lint_source(path: str, source: str) -> List[str]:
             problems.append(
                 f"{where}: histogram {raw!r} must end in one of "
                 f"{'/'.join(_HIST_SUFFIXES)}"
+            )
+    return problems
+
+
+# one /metrics sample: name, optional {labels}, value, and (bucket lines
+# only) the exemplar suffix `` # {trace_id="<hex>"} <value>``
+_EXPOSITION_SAMPLE_RE = re.compile(
+    r"""^(?P<name>[a-z_][a-z0-9_]*)
+        (?P<labels>\{[^}]*\})?
+        [ ](?P<value>[0-9.eE+-]+|\+Inf|-Inf|NaN)
+        (?P<exemplar>[ ]\#[ ]\{trace_id="[0-9a-f]+"\}[ ][0-9.eE+-]+)?
+        $""",
+    re.VERBOSE,
+)
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Validate rendered /metrics text (``Metrics.prometheus_text()``):
+    every non-comment line must parse as a sample, and an exemplar
+    suffix may ride only on histogram ``_bucket`` lines."""
+    problems: List[str] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line or line.startswith("#"):
+            continue
+        m = _EXPOSITION_SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"exposition line {i}: unparseable sample {line!r}")
+            continue
+        if m.group("exemplar") and not m.group("name").endswith("_bucket"):
+            problems.append(
+                f"exposition line {i}: exemplar on non-bucket series "
+                f"{m.group('name')!r}"
             )
     return problems
 

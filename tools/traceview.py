@@ -1,0 +1,219 @@
+"""traceview — render one request's trace from a JSONL span export.
+
+The serving plane writes spans as JSON lines (``Tracer.export_jsonl`` /
+the ``/traces`` endpoint piped through ``jq -c '.[] | .spans[]'``); this
+tool turns one trace back into the thing an engineer actually wants at
+3am: the span TREE (who called whom, where the time went) and the token
+TIMELINE of the decode loop (admission wait, prefill, TTFT, the TPOT
+samples, why the request retired).
+
+Usage::
+
+    python tools/traceview.py spans.jsonl                 # slowest request
+    python tools/traceview.py spans.jsonl --trace-id <id> # that one
+    python tools/traceview.py spans.jsonl --list          # trace index
+
+With no ``--trace-id`` the tool picks the SLOWEST request trace in the
+file (longest root-span duration) — tail sampling keeps exactly the
+traces worth reading, and the slowest kept one is where an investigation
+starts. Exit code: 0 on a rendered trace, 1 on no match/empty file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+Span = Dict[str, Any]
+
+
+def load_spans(path: str) -> List[Span]:
+    """Parse one span dict per line; blank/corrupt lines are skipped
+    (a live exporter may be appending mid-line at read time)."""
+    spans: List[Span] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(d, dict) and "trace_id" in d and "span_id" in d:
+                spans.append(d)
+    return spans
+
+
+def group_traces(spans: List[Span]) -> Dict[str, List[Span]]:
+    by_trace: Dict[str, List[Span]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    for group in by_trace.values():
+        group.sort(key=lambda s: s.get("start_time") or 0.0)
+    return by_trace
+
+
+def _roots(group: List[Span]) -> List[Span]:
+    ids = {s["span_id"] for s in group}
+    return [s for s in group if s.get("parent_id") not in ids]
+
+
+def _duration(s: Span) -> float:
+    d = s.get("duration_s")
+    return float(d) if d is not None else 0.0
+
+
+def pick_slowest(by_trace: Dict[str, List[Span]]) -> Optional[str]:
+    """The trace whose slowest root span is longest — for request traces
+    that root is the client/gateway span, i.e. end-to-end latency."""
+    best, best_d = None, -1.0
+    for tid, group in by_trace.items():
+        d = max((_duration(r) for r in _roots(group)), default=0.0)
+        if d > best_d:
+            best, best_d = tid, d
+    return best
+
+
+def _fmt_ms(seconds: Optional[float]) -> str:
+    return "   ?   " if seconds is None else f"{seconds * 1000.0:8.2f}ms"
+
+
+def _fmt_attrs(attrs: Dict[str, Any]) -> str:
+    if not attrs:
+        return ""
+    inner = ", ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+    return f"  [{inner}]"
+
+
+def _render_events(span: Span, indent: str, out: List[str]) -> None:
+    events = span.get("events") or []
+    if not events:
+        return
+    t0 = span.get("start_time") or 0.0
+    for e in events:
+        off = (e.get("ts") or t0) - t0
+        out.append(
+            f"{indent}  @ {off * 1000.0:8.2f}ms {e.get('name', '?')}"
+            f"{_fmt_attrs(e.get('attributes') or {})}"
+        )
+
+
+def render_tree(group: List[Span]) -> List[str]:
+    """Indented span tree, children under parents in start order; spans
+    whose parent never made it into the export surface as extra roots
+    rather than vanishing."""
+    children: Dict[str, List[Span]] = {}
+    for s in group:
+        children.setdefault(s.get("parent_id") or "", []).append(s)
+    out: List[str] = []
+
+    def walk(span: Span, depth: int) -> None:
+        indent = "  " * depth
+        status = span.get("status", "ok")
+        flag = "" if status == "ok" else f"  !{status}: {span.get('message', '')}"
+        out.append(
+            f"{indent}{'└─ ' if depth else ''}{span['name']}"
+            f"  {_fmt_ms(span.get('duration_s'))}"
+            f"{_fmt_attrs(span.get('attributes') or {})}{flag}"
+        )
+        _render_events(span, indent + ("   " if depth else ""), out)
+        for child in children.get(span["span_id"], []):
+            walk(child, depth + 1)
+
+    for root in _roots(group):
+        walk(root, 0)
+    return out
+
+
+def render_token_timeline(group: List[Span]) -> List[str]:
+    """The decode-loop view: for each ``serve.request`` span, the token
+    events as a sparkline-ish table — TTFT first, then the (strided)
+    TPOT samples, then the retirement reason."""
+    out: List[str] = []
+    for span in group:
+        if span.get("name") != "serve.request":
+            continue
+        events = span.get("events") or []
+        ttft = next(
+            (e for e in events if e.get("name") == "first_token"), None
+        )
+        tokens = [e for e in events if e.get("name") == "token"]
+        retire = next((e for e in events if e.get("name") == "retire"), None)
+        attrs = span.get("attributes") or {}
+        out.append(
+            f"token timeline ({attrs.get('tokens_out', '?')} tokens, "
+            f"prefix-cache {attrs.get('cached_pages', 0)} page(s), "
+            f"{attrs.get('prefill_chunks', 0)} prefill chunk(s)):"
+        )
+        if ttft is not None:
+            a = ttft.get("attributes") or {}
+            out.append(f"  ttft  {float(a.get('ttft_s', 0.0)) * 1000.0:8.2f}ms")
+        for e in tokens:
+            a = e.get("attributes") or {}
+            out.append(
+                f"  tok {int(a.get('i', 0)):4d}  "
+                f"tpot {float(a.get('tpot_s', 0.0)) * 1000.0:7.3f}ms"
+            )
+        if retire is not None:
+            a = retire.get("attributes") or {}
+            out.append(
+                f"  retired: {a.get('reason', '?')} "
+                f"after {a.get('tokens', '?')} token(s)"
+            )
+    return out
+
+
+def render_trace(trace_id: str, group: List[Span]) -> str:
+    total = max((_duration(r) for r in _roots(group)), default=0.0)
+    lines = [
+        f"trace {trace_id}  ({len(group)} span(s), {total * 1000.0:.2f}ms)"
+    ]
+    lines.extend(render_tree(group))
+    timeline = render_token_timeline(group)
+    if timeline:
+        lines.append("")
+        lines.extend(timeline)
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="traceview", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("path", help="JSONL span export (one span per line)")
+    ap.add_argument("--trace-id", help="render this trace (default: slowest)")
+    ap.add_argument(
+        "--list", action="store_true",
+        help="index of traces in the file, slowest first",
+    )
+    args = ap.parse_args(argv)
+
+    by_trace = group_traces(load_spans(args.path))
+    if not by_trace:
+        print("no spans found", file=sys.stderr)
+        return 1
+
+    if args.list:
+        rows = sorted(
+            by_trace.items(),
+            key=lambda kv: -max((_duration(r) for r in _roots(kv[1])), default=0.0),
+        )
+        for tid, group in rows:
+            d = max((_duration(r) for r in _roots(group)), default=0.0)
+            root = _roots(group)[0]["name"] if _roots(group) else "?"
+            print(f"{tid}  {d * 1000.0:8.2f}ms  {len(group):3d} span(s)  {root}")
+        return 0
+
+    tid = args.trace_id or pick_slowest(by_trace)
+    if tid not in by_trace:
+        print(f"trace {tid!r} not in file", file=sys.stderr)
+        return 1
+    print(render_trace(tid, by_trace[tid]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
