@@ -928,6 +928,209 @@ def _gateway_probe(small: bool, full: bool = False):
         obstrace.set_tracer(prev_tracer)
 
 
+def _chaos_serving_probe(small: bool, full: bool = False):
+    """Fault-tolerant serving under chaos (ISSUE 13): an offered-QPS
+    load through the REAL gateway onto decode-loop gpt replicas the
+    actual controller + kubelet brought up, then a SEEDED kill of
+    1-of-3 mid-generation (tests/chaos.ChaosInjector — replayable from
+    its seed). Acceptance: ``chaos_failed_requests == 0`` — the kill is
+    invisible to a well-formed request because the dispatch loop
+    re-routes its mid-flight transport failure to a survivor inside the
+    caller's deadline — every failure typed, and ``ejection_time_ms``
+    (kill -> the LAST request routed to the corpse) bounded well under
+    the passive ``STALE_AFTER_S`` window the health machinery
+    preempts."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import tfk8s_tpu.runtime.kubelet as kubelet_mod
+    import tfk8s_tpu.trainer.serve_controller as sc_mod
+    from tfk8s_tpu.api.types import (
+        BatchingPolicy,
+        ObjectMeta,
+        TPUServe,
+        TPUServeSpec,
+    )
+    from tfk8s_tpu.client import FakeClientset
+    from tfk8s_tpu.client.store import StoreError
+    from tfk8s_tpu.gateway.client import GatewayClient
+    from tfk8s_tpu.gateway.router import STALE_AFTER_S
+    from tfk8s_tpu.gateway.server import GatewayServer
+    from tfk8s_tpu.obs import trace as obstrace
+    from tfk8s_tpu.runtime import LocalKubelet
+    from tfk8s_tpu.runtime.server import ServeError
+    from tfk8s_tpu.trainer import TPUServeController
+    from tfk8s_tpu.utils.logging import Metrics
+
+    # the chaos shapes live with the test harness, not the package
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests")
+    )
+    from chaos import ChaosInjector
+
+    small_mode = small and not full
+    if small_mode:
+        qps, dur = 25, 3.0
+    else:
+        qps, dur = 40, 6.0
+    replicas, seed = 3, 13
+    kill_at_s = dur / 3.0
+
+    flush0 = kubelet_mod.LOG_FLUSH_SECONDS
+    period0 = sc_mod.AUTOSCALE_PERIOD_S
+    prev_tracer = obstrace.set_tracer(obstrace.Tracer(enabled=False))
+    kubelet_mod.LOG_FLUSH_SECONDS = 0.05
+    sc_mod.AUTOSCALE_PERIOD_S = 0.1
+    cs = FakeClientset()
+    ctrl = TPUServeController(cs)
+    kubelet = LocalKubelet(cs)
+    stop = threading.Event()
+    kubelet.run(stop)
+    ctrl.run(workers=2, stop=stop, block=False)
+    gw = GatewayServer(cs, port=0, metrics=Metrics())
+    gw.serve_background()
+    name = "bench-chaos"
+    try:
+        serve = TPUServe(
+            metadata=ObjectMeta(name=name),
+            spec=TPUServeSpec(
+                task="gpt", checkpoint="seed:0", replicas=replicas,
+                batching=BatchingPolicy(
+                    max_batch_size=8, batch_timeout_ms=2.0, queue_limit=256
+                ),
+            ),
+        )
+        serve.spec.template.env.update({
+            "TFK8S_SERVE_GPT_SIZE": "tiny",
+            "TFK8S_SERVE_GEN_TOKENS": "8",
+            "TFK8S_SERVE_PAGE_SIZE": "8",
+            "TFK8S_SERVE_MAX_PAGES": "128",
+            "TFK8S_SERVE_PREFILL_CHUNK": "16",
+        })
+        cs.tpuserves().create(serve)
+
+        def wait_ready(n, timeout_s):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    if cs.tpuserves().get(name).status.ready_replicas >= n:
+                        return True
+                except StoreError:
+                    pass
+                time.sleep(0.05)
+            return False
+
+        if not wait_ready(replicas, 120):
+            raise RuntimeError("chaos bench replicas never became Ready")
+
+        rng = np.random.default_rng(seed)
+        prompts = [
+            [int(t) for t in rng.integers(1, 64, size=int(pl))]
+            for pl in rng.integers(4, 17, size=32)
+        ]
+        client = GatewayClient(gw.url, name)
+        # compile-warm every replica (least-loaded routing spreads the
+        # warm requests as each busy replica's depth rises)
+        for _ in range(replicas * 2):
+            client.request({"tokens": prompts[0], "gen_tokens": 2},
+                           timeout=120)
+
+        failures = {"typed": 0, "untyped": 0}
+        lock = threading.Lock()
+
+        def one(i):
+            t0 = time.perf_counter()
+            try:
+                client.request(
+                    {"tokens": prompts[i % len(prompts)],
+                     "gen_tokens": 4 + i % 5},
+                    timeout=15,
+                )
+                return time.perf_counter() - t0
+            except (ServeError, StoreError):
+                with lock:
+                    failures["typed"] += 1
+            except Exception:  # noqa: BLE001 — an UNtyped failure
+                with lock:
+                    failures["untyped"] += 1
+            return None
+
+        # the seeded mid-generation kill, launched with the load
+        injector = ChaosInjector(cs, kubelet, seed=seed)
+        state = gw.state_for("default", name)
+        victim: dict = {}
+
+        def chaos():
+            time.sleep(kill_at_s)
+            pod = injector.pick_replica(name)
+            if pod is None:
+                return
+            victim["key"] = pod.metadata.key
+            victim["t"] = time.monotonic()
+            injector.kill_replica(pod)
+
+        chaos_thread = threading.Thread(target=chaos, daemon=True)
+        n = int(qps * dur)
+        interval = 1.0 / qps
+        futs = []
+        with ThreadPoolExecutor(max_workers=32) as pool:
+            t_start = time.perf_counter()
+            chaos_thread.start()
+            for i in range(n):
+                target = t_start + i * interval
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                futs.append(pool.submit(one, i))
+            results = [f.result() for f in futs]
+        chaos_thread.join(timeout=5)
+
+        lat = sorted(r for r in results if r is not None)
+        failed = len(results) - len(lat)
+        # kill -> the LAST request the router sent to the corpse: the
+        # active-discovery bound the passive stale window only backstops
+        last = (
+            state.table.last_pick_s(victim["key"]) if victim else None
+        )
+        ejection_ms = (
+            round(max(0.0, (last - victim["t"]) * 1000), 1)
+            if victim and last is not None else 0.0
+        )
+        replaced = wait_ready(replicas, 60)
+        client.close()
+        return {
+            "chaos_model": "gpt-tiny",
+            "chaos_replicas": replicas,
+            "chaos_seed": seed,
+            "chaos_offered_qps": qps,
+            "chaos_requests": n,
+            "chaos_served": len(lat),
+            "chaos_failed_requests": failed,
+            "chaos_failed_typed": failures["typed"],
+            "chaos_failed_untyped": failures["untyped"],
+            "chaos_p50_ms": round(lat[len(lat) // 2] * 1000, 3)
+            if lat else None,
+            "chaos_p99_ms": round(
+                lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1000, 3
+            ) if lat else None,
+            "chaos_kill_at_s": kill_at_s,
+            "chaos_victim": victim.get("key"),
+            "ejection_time_ms": ejection_ms,
+            "chaos_stale_after_ms": STALE_AFTER_S * 1000,
+            "chaos_replica_replaced": replaced,
+        }
+    finally:
+        stop.set()
+        gw.shutdown()
+        gw.server_close()
+        ctrl.controller.shutdown()
+        kubelet_mod.LOG_FLUSH_SECONDS = flush0
+        sc_mod.AUTOSCALE_PERIOD_S = period0
+        obstrace.set_tracer(prev_tracer)
+
+
 def _gen_serving_probe(small: bool, full: bool = False):
     """Generative serving throughput (ISSUE 7): the continuous-batching
     decode loop (runtime/server.DecodeLoopExecutor — token-granularity
@@ -1653,6 +1856,18 @@ def main() -> None:
             print(f"bench: gateway probe failed: {exc}", file=sys.stderr)
             degraded.append("gateway")
 
+    # -- serving chaos: seeded kill of 1-of-3 replicas mid-generation
+    # under offered-QPS load (hermetic: real sockets, fake cluster) ------
+    chaos_block = None
+    if os.environ.get("BENCH_CHAOS", "1") == "1":
+        try:
+            chaos_block = _chaos_serving_probe(
+                small, full=os.environ.get("BENCH_CHAOS_FULL") == "1"
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: chaos serving probe failed: {exc}", file=sys.stderr)
+            degraded.append("chaos_serving")
+
     # -- elastic recovery: reclaim-notice -> resized-gang-training time
     # against the real controller + kubelet (hermetic, chip-free) --------
     recovery_block = None
@@ -1866,6 +2081,10 @@ def main() -> None:
                         if gen_serving_block else {}
                     ),
                     **({"gateway": gateway_block} if gateway_block else {}),
+                    **(
+                        {"chaos_serving": chaos_block}
+                        if chaos_block else {}
+                    ),
                     **({"recovery": recovery_block} if recovery_block else {}),
                     **(
                         {
@@ -1931,7 +2150,7 @@ def main() -> None:
     print(
         build_headline(
             detail, image_block, detail_name, serving_block, recovery_block,
-            gen_serving_block, gateway_block,
+            gen_serving_block, gateway_block, chaos_block,
         )
     )
 
@@ -1946,6 +2165,7 @@ HEADLINE_MAX_CHARS = 1800
 def build_headline(
     detail: dict, image_block, detail_name, serving_block=None,
     recovery_block=None, gen_serving_block=None, gateway_block=None,
+    chaos_block=None,
 ) -> str:
     """Assemble the final-stdout headline line from the full detail
     record: the fixed key set, the image-decode and serving rows when
@@ -2046,6 +2266,22 @@ def build_headline(
                 if k in gateway_block
             }
         )
+    if chaos_block:
+        # the serving-chaos rows ride the headline: requests lost to the
+        # seeded mid-generation kill (the acceptance key — must be 0),
+        # the p99 under chaos, and how fast the health machinery stopped
+        # routing to the corpse
+        headline_extra.update(
+            {
+                k: chaos_block[k]
+                for k in (
+                    "chaos_failed_requests",
+                    "chaos_p99_ms",
+                    "ejection_time_ms",
+                )
+                if k in chaos_block
+            }
+        )
     if recovery_block:
         # the elastic-recovery rows ride the headline: seconds from a
         # reclaim notice to the RESIZED gang's first post-resize optimizer
@@ -2079,11 +2315,13 @@ def build_headline(
         "gen_tokens_per_s_baseline", "gen_speedup_vs_batch",
         "gateway_trace_overhead",
         "gateway_wire_efficiency", "gateway_p99_ms",
+        "chaos_p99_ms", "ejection_time_ms",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
         "image_meets_budget", "img_per_sec_native",
         "serving_p99_ms", "serving_qps",
         "gateway_fairness_ratio", "gateway_qps",
+        "chaos_failed_requests",
         "ttft_p99_ms",
         "tpot_p99_ms", "gen_tokens_per_s",
         "recovery_p99_s", "recovery_p50_s",

@@ -21,8 +21,23 @@ Composing them yields the three reclaim shapes real fleets see:
                               with no warning at all (legacy whole-gang
                               restart path).
 
+ISSUE 13 adds the SERVING fault shapes, driving the chaos hooks the
+model-server runtime exposes (`runtime/server.py`):
+
+- ``kill_replica(pod)``       the replica host dies mid-generation:
+                              in-flight rows fail typed, the replica
+                              exits non-Ready, the serve controller
+                              replaces it;
+- ``wire_reset(pod)``         accepted-but-unanswered requests fail
+                              with a transport error; the host lives;
+- ``gray_replica(pod, s)``    alive, correct, SLOW — the gateway's gray
+                              detector has to find it, not a timeout;
+- ``flap(serve, ...)``        kill-recover loops.
+
 Every random choice goes through one seeded ``random.Random`` so a
-failing sweep replays bit-for-bit from its seed.
+failing sweep replays bit-for-bit from its seed —
+``plan_serving_faults`` materializes a whole campaign up front for the
+same reason (pinned by the replay test).
 """
 
 from __future__ import annotations
@@ -102,3 +117,93 @@ class ChaosInjector:
             self.kubelet.chaos_fail(
                 pod.metadata.key, "chaos: node died mid-drain (late notice)"
             )
+
+    # -- serving fault shapes (ISSUE 13) ------------------------------------
+
+    def running_replicas(self, serve_name: str,
+                         namespace: str = "default") -> List[Pod]:
+        pods, _rv = self.cs.pods(namespace).list(
+            label_selector=L.serve_selector(serve_name)
+        )
+        return sorted(
+            (
+                p for p in pods
+                if p.status.phase == PodPhase.RUNNING
+                and p.metadata.deletion_timestamp is None
+            ),
+            key=lambda p: p.metadata.name,
+        )
+
+    def pick_replica(self, serve_name: str,
+                     namespace: str = "default") -> Optional[Pod]:
+        """Seeded choice among the serve's RUNNING replicas."""
+        pods = self.running_replicas(serve_name, namespace)
+        return self.rng.choice(pods) if pods else None
+
+    def kill_replica(self, pod: Pod) -> bool:
+        """The replica HOST dies mid-generation: every in-flight row on
+        it fails typed ``ReplicaUnavailable``, the replica publishes
+        non-Ready and its ``serve()`` entrypoint exits FAILED — the
+        serve controller replaces the carcass."""
+        from tfk8s_tpu.runtime import server as serving
+
+        self.log.append((time.time(), "kill_replica", pod.metadata.key))
+        return serving.chaos_crash_replica(pod.metadata.key)
+
+    def wire_reset(self, pod: Pod) -> bool:
+        """Cut the wire under every accepted-but-unanswered request:
+        in-flight and queued requests fail with a transport error, but
+        the HOST lives — the replica keeps serving new submissions."""
+        from tfk8s_tpu.runtime import server as serving
+
+        self.log.append((time.time(), "wire_reset", pod.metadata.key))
+        server = serving.lookup_replica(pod.metadata.key)
+        reset = getattr(server, "chaos_wire_reset", None)
+        if reset is None:
+            return False
+        reset()
+        return True
+
+    def gray_replica(self, pod: Pod, delay_s: float = 0.05) -> bool:
+        """Make the replica GRAY: alive, correct, slow. Every submit
+        gains ``delay_s`` of latency, so only the gateway's latency-
+        EWMA-vs-fleet-median detector (not a timeout, not an error
+        counter) can find it. ``delay_s=0`` heals it."""
+        from tfk8s_tpu.runtime import server as serving
+
+        self.log.append((time.time(), "gray_replica", pod.metadata.key))
+        server = serving.lookup_replica(pod.metadata.key)
+        delay = getattr(server, "chaos_delay", None)
+        if delay is None:
+            return False
+        delay(delay_s)
+        return True
+
+    def flap(self, serve_name: str, namespace: str = "default",
+             rounds: int = 2, settle_s: float = 0.5) -> List[str]:
+        """Kill-recover loop: kill a seeded replica, give the serve
+        controller ``settle_s`` to replace it, repeat. Returns the pod
+        keys killed, in order."""
+        killed: List[str] = []
+        for _ in range(rounds):
+            pod = self.pick_replica(serve_name, namespace)
+            if pod is None:
+                break
+            self.kill_replica(pod)
+            killed.append(pod.metadata.key)
+            time.sleep(settle_s)
+        return killed
+
+    def plan_serving_faults(
+        self, shapes: List[str], rounds: int,
+        min_gap_s: float = 0.05, max_gap_s: float = 0.2,
+    ) -> List[tuple]:
+        """Materialize a whole fault campaign up front: ``rounds`` draws
+        of ``(gap_s, shape)``, every draw through the injector's ONE
+        seeded rng. The same seed always plans the same campaign — the
+        replay test pins it — and a failing sweep's schedule can be
+        re-run bit-for-bit from its seed."""
+        return [
+            (self.rng.uniform(min_gap_s, max_gap_s), self.rng.choice(shapes))
+            for _ in range(rounds)
+        ]

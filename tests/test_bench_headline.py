@@ -126,6 +126,26 @@ FULL_GATEWAY_BLOCK = {
 }
 
 
+FULL_CHAOS_BLOCK = {
+    "chaos_model": "gpt-tiny",
+    "chaos_replicas": 3,
+    "chaos_seed": 13,
+    "chaos_offered_qps": 25,
+    "chaos_requests": 75,
+    "chaos_served": 75,
+    "chaos_failed_requests": 0,
+    "chaos_failed_typed": 0,
+    "chaos_failed_untyped": 0,
+    "chaos_p50_ms": 21.4,
+    "chaos_p99_ms": 182.7,
+    "chaos_kill_at_s": 1.0,
+    "chaos_victim": "default/bench-chaos-1",
+    "ejection_time_ms": 61.2,
+    "chaos_stale_after_ms": 3000.0,
+    "chaos_replica_replaced": True,
+}
+
+
 FULL_RECOVERY_BLOCK = {
     "recovery_workers": 4,
     "recovery_min_replicas": 2,
@@ -144,7 +164,7 @@ def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
         FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
-        FULL_GATEWAY_BLOCK,
+        FULL_GATEWAY_BLOCK, FULL_CHAOS_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -185,6 +205,14 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert parsed["extra"]["gateway_p99_ms"] == 88.0
     assert parsed["extra"]["gateway_wire_efficiency"] == 0.849
     assert parsed["extra"]["gateway_fairness_ratio"] == 0.981
+    # ISSUE-13 serving-chaos acceptance keys
+    assert parsed["extra"]["chaos_failed_requests"] == 0
+    assert parsed["extra"]["chaos_p99_ms"] == 182.7
+    assert parsed["extra"]["ejection_time_ms"] == 61.2
+    # ...the chaos campaign detail stays in the detail record
+    assert "chaos_victim" not in parsed["extra"]
+    assert "chaos_seed" not in parsed["extra"]
+    assert "chaos_served" not in parsed["extra"]
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -195,6 +223,7 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     line = bench.build_headline(
         _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
         FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK, FULL_GATEWAY_BLOCK,
+        FULL_CHAOS_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -212,6 +241,7 @@ def test_headline_without_image_block():
     assert "recovery_p50_s" not in parsed["extra"]
     assert "gen_tokens_per_s" not in parsed["extra"]
     assert "gateway_qps" not in parsed["extra"]
+    assert "chaos_failed_requests" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
@@ -230,5 +260,7 @@ def test_serving_keys_in_drop_order():
                 "gen_speedup_vs_batch", "gen_tokens_per_s_baseline",
                 "gateway_qps", "gateway_p99_ms",
                 "gateway_wire_efficiency", "gateway_trace_overhead",
-                "gateway_fairness_ratio"):
+                "gateway_fairness_ratio",
+                "chaos_failed_requests", "chaos_p99_ms",
+                "ejection_time_ms"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

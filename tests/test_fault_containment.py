@@ -1,0 +1,292 @@
+"""Crash containment in the decode loop (ISSUE 13): a single-row fault
+retires THAT row typed (:class:`RowFault`) and quarantines its pages —
+never returned to the free list (or the prefix cache) until explicitly
+verified — while every sibling row keeps decoding bit-identically to an
+uninjected run and to the ``gpt.generate`` ground truth. Only a GLOBAL
+fault fails the world: ``chaos_crash`` fails every held request with
+retriable ``ReplicaUnavailable`` and flips ``serving_ready`` to 0;
+``chaos_wire_reset`` fails in-flight requests but the replica keeps
+serving."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from tfk8s_tpu.runtime.paging import PageAllocator
+from tfk8s_tpu.runtime.server import (
+    DecodeLoopExecutor,
+    PagedGptDecoder,
+    ReplicaUnavailable,
+    RowFault,
+)
+from tfk8s_tpu.utils.logging import Metrics
+
+# ---------------------------------------------------------------------------
+# PageAllocator quarantine — pure host-side unit (no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantine:
+    def test_quarantine_holds_pages_out_of_the_free_list(self):
+        a = PageAllocator(num_pages=8, page_size=4, prefix_cache=False)
+        lease = a.admit(list(range(6)), gen_budget=6)  # 3 pages
+        for _ in range(lease.reserved):
+            a.extend(lease)
+        free_before_fault = a.free_pages
+        held = a.quarantine(lease)
+        assert held == 3
+        assert a.quarantined_pages == 3
+        # release() would have returned them; quarantine must NOT
+        assert a.free_pages == free_before_fault
+        assert lease.pages == []
+
+    def test_verify_returns_quarantined_pages_to_circulation(self):
+        a = PageAllocator(num_pages=8, page_size=4, prefix_cache=False)
+        lease = a.admit(list(range(6)), gen_budget=6)
+        for _ in range(lease.reserved):
+            a.extend(lease)
+        a.quarantine(lease)
+        free_held = a.free_pages
+        assert a.verify_quarantined() == 3
+        assert a.quarantined_pages == 0
+        assert a.free_pages == free_held + 3
+
+    def test_tainted_shared_page_diverts_at_final_release(self):
+        """A quarantined page still pinned by a live sibling lease stays
+        readable for the sibling (its content predates the fault) but
+        must quarantine — not free — when the sibling releases it."""
+        a = PageAllocator(num_pages=16, page_size=4)
+        prompt = list(range(10, 22))  # 12 tokens -> 2 full reusable pages
+        l1 = a.admit(prompt, gen_budget=4)
+        for _ in range(l1.reserved):
+            a.extend(l1)
+        a.register_prefix(prompt, l1)
+        l2 = a.admit(prompt, gen_budget=4)
+        assert l2.cached_pages == 2
+        shared = list(l2.pages[:2])
+
+        a.quarantine(l1)  # l1 faulted; l2 still holds the shared pages
+        assert a.quarantined_pages >= 2
+        free_before = a.free_pages
+        a.release(l2)  # the LAST holder releases: divert, don't free
+        for pid in shared:
+            assert pid in a._quarantined
+        # nothing l2 held went back to the free list
+        assert a.free_pages == free_before
+        assert a.verify_quarantined() >= 2
+
+    def test_quarantine_unpublishes_the_prefix(self):
+        a = PageAllocator(num_pages=16, page_size=4)
+        prompt = list(range(50, 62))
+        lease = a.admit(prompt, gen_budget=4)
+        for _ in range(lease.reserved):
+            a.extend(lease)
+        a.register_prefix(prompt, lease)
+        assert a.match_prefix(prompt)[1] > 0
+        a.quarantine(lease)
+        # a poisoned page must never serve a future prefix hit
+        assert a.match_prefix(prompt) == ([], 0)
+
+
+# ---------------------------------------------------------------------------
+# Decode-loop containment — real tiny GPT on the CPU backend
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def decoder():
+    dec = PagedGptDecoder(
+        "seed:0", slots=4, page_size=8, max_pages=64, gen_tokens=8,
+        size="tiny", prefill_chunk=16,
+    )
+    dec.load()
+    return dec
+
+
+def make_loop(decoder, **kw):
+    kw.setdefault("queue_limit", 32)
+    kw.setdefault("metrics", Metrics())
+    return DecodeLoopExecutor(decoder, **kw).start()
+
+
+def prompts(seeds, n=6):
+    return [
+        np.random.default_rng(s).integers(1, 64, size=n).astype(np.int32)
+        for s in seeds
+    ]
+
+
+def run_batch(loop, batch, gen=5):
+    """Submit every prompt concurrently; returns (outputs, errors) maps
+    keyed by prompt index."""
+    outs, errs = {}, {}
+
+    def one(i, toks):
+        try:
+            outs[i] = loop.submit(
+                {"tokens": toks, "gen_tokens": gen}, timeout=120
+            )
+        except Exception as e:  # noqa: BLE001 — the test types them
+            errs[i] = e
+
+    with ThreadPoolExecutor(len(batch)) as pool:
+        futs = [pool.submit(one, i, t) for i, t in enumerate(batch)]
+        for f in futs:
+            f.result(timeout=120)
+    return outs, errs
+
+
+class TestSingleRowIsolation:
+    def test_poisoned_row_retires_typed_siblings_bit_identical(self, decoder):
+        """THE containment property: poison ONE row's decode, and (a)
+        that request fails typed RowFault, (b) every sibling's tokens
+        are bit-identical to an uninjected run AND to the contiguous
+        ``gpt.generate`` ground truth, (c) the poisoned pages are
+        quarantined and the quarantine metric counts the row."""
+        import jax.numpy as jnp
+
+        from tfk8s_tpu.models import gpt
+
+        batch = prompts([101, 102, 103])
+        metrics = Metrics()
+        loop = make_loop(decoder, metrics=metrics)
+        try:
+            baseline, errs = run_batch(loop, batch)
+            assert not errs
+            quarantined_before = loop.allocator.quarantined_pages
+
+            loop.chaos_poison_row(batch[1])
+            outs, errs = run_batch(loop, batch)
+
+            assert set(errs) == {1}
+            assert isinstance(errs[1], RowFault)
+            assert "quarantined" in str(errs[1])
+            for i in (0, 2):
+                np.testing.assert_array_equal(
+                    outs[i]["tokens"], baseline[i]["tokens"]
+                )
+                ground = np.asarray(gpt.generate(
+                    decoder._cfg, decoder._params,
+                    jnp.asarray(batch[i])[None], num_tokens=5,
+                ))[0]
+                np.testing.assert_array_equal(outs[i]["tokens"], ground)
+            assert loop.allocator.quarantined_pages > quarantined_before
+            assert metrics.get_counter(
+                "tfk8s_serving_rows_quarantined_total", loop.labels
+            ) == 1.0
+            # the fault was CONTAINED: the loop is alive and not faulted
+            assert loop.fault is None
+            assert loop.report_progress()["serving_ready"] == 1.0
+        finally:
+            loop.drain(10)
+
+    def test_quarantined_pages_survive_allocation_churn(self, decoder):
+        """Quarantined pages never re-enter the free list unverified —
+        serving MORE traffic after the fault must not recycle them."""
+        metrics = Metrics()
+        loop = make_loop(decoder, metrics=metrics)
+        a = loop.allocator
+        try:
+            victim = prompts([7], n=10)[0]
+            loop.chaos_poison_row(victim)
+            _, errs = run_batch(loop, [victim])
+            assert isinstance(errs[0], RowFault)
+            held = a.quarantined_pages
+            assert held > 0
+            frozen = set(a._quarantined)
+
+            # churn the pool: every allocation drains and refills free
+            outs, errs = run_batch(loop, prompts(range(8)))
+            assert not errs and len(outs) == 8
+            assert set(a._quarantined) == frozen
+            assert a.quarantined_pages == held
+
+            freed = a.verify_quarantined()
+            assert freed > 0
+            assert a.quarantined_pages == len(a._tainted)
+        finally:
+            loop.drain(10)
+
+
+class SlowDecoder(PagedGptDecoder):
+    step_sleep_s = 0.004
+
+    def decode(self, state):
+        time.sleep(self.step_sleep_s)
+        return super().decode(state)
+
+
+def slow_loop():
+    dec = SlowDecoder(
+        "seed:0", slots=4, page_size=8, max_pages=64, gen_tokens=8,
+        size="tiny", prefill_chunk=16,
+    )
+    dec.load()
+    return make_loop(dec)
+
+
+def wait_until(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.001)
+    return False
+
+
+class TestGlobalFaults:
+    def test_chaos_crash_fails_everything_typed_and_goes_non_ready(self):
+        loop = slow_loop()
+        errs = []
+
+        def run():
+            try:
+                loop.submit({"tokens": prompts([1], n=8)[0],
+                             "gen_tokens": 40}, timeout=120)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert wait_until(lambda: loop.live_slots >= 1)
+        loop.chaos_crash()
+        t.join(timeout=30)
+        assert len(errs) == 1 and isinstance(errs[0], ReplicaUnavailable)
+        # the corpse refuses new work with the same retriable class...
+        with pytest.raises(ReplicaUnavailable):
+            loop.submit({"tokens": prompts([2], n=4)[0], "gen_tokens": 2},
+                        timeout=5)
+        # ...and publishes non-Ready so the controller replaces it
+        assert loop.fault is not None
+        assert loop.report_progress()["serving_ready"] == 0.0
+
+    def test_chaos_wire_reset_fails_inflight_but_replica_keeps_serving(self):
+        loop = slow_loop()
+        try:
+            errs = []
+
+            def run():
+                try:
+                    loop.submit({"tokens": prompts([3], n=8)[0],
+                                 "gen_tokens": 40}, timeout=120)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            assert wait_until(lambda: loop.live_slots >= 1)
+            loop.chaos_wire_reset()
+            t.join(timeout=30)
+            assert len(errs) == 1 and isinstance(errs[0], ReplicaUnavailable)
+            # the HOST lives: the very next submit is served
+            out = loop.submit(
+                {"tokens": prompts([4], n=4)[0], "gen_tokens": 3}, timeout=60
+            )
+            assert len(out["tokens"]) == 3
+            assert loop.fault is None
+            assert loop.report_progress()["serving_ready"] == 1.0
+        finally:
+            loop.drain(10)

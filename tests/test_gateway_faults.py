@@ -1,0 +1,284 @@
+"""In-flight failure recovery on the dispatch path (ISSUE 13): a
+replica that dies while it HOLDS an idempotent serve request is
+retriable on a survivor — bounded per request by MAX_DISPATCH_RETRIES
+and fleet-wide by the serve's token-bucket retry budget — and every
+terminal failure leaves the gateway typed, naming the replicas the
+deadline was burned on (``details.triedReplicas``, pinned here).
+
+Also the client-side halves of the contract: the stale-bytes
+reconnect-hygiene regression (a garbled frame must DROP the warm
+socket, not leave the next request reading the previous response) and
+the bounded transport retry."""
+
+import http.client
+import json
+import socket
+import threading
+
+import pytest
+
+import tfk8s_tpu.gateway.server as gw_mod
+from tfk8s_tpu.api.types import (
+    BatchingPolicy,
+    ObjectMeta,
+    TPUServe,
+    TPUServeSpec,
+)
+from tfk8s_tpu.client import FakeClientset
+from tfk8s_tpu.client.store import Unavailable
+from tfk8s_tpu.gateway import health as H
+from tfk8s_tpu.gateway.client import GatewayClient
+from tfk8s_tpu.gateway.server import MAX_DISPATCH_RETRIES, GatewayServer
+from tfk8s_tpu.runtime.server import DeadlineExceeded, ReplicaUnavailable
+from tfk8s_tpu.utils.logging import Metrics
+
+
+class _Replica:
+    """A fake registered replica: ``submit`` runs ``fn(payload)``."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = 0
+
+    def submit(self, payload, timeout=None, **kw):
+        self.calls += 1
+        return self.fn(payload)
+
+
+def _crash(payload):
+    raise ReplicaUnavailable("chaos: replica host died mid-flight")
+
+
+class _NoBudget:
+    def try_accept(self):
+        return False
+
+
+@pytest.fixture
+def gw():
+    cs = FakeClientset()
+    metrics = Metrics()
+    server = GatewayServer(cs, port=0, metrics=metrics)
+    server.serve_background()
+    yield cs, server, metrics
+    server.shutdown()
+    server.server_close()
+
+
+def make_state(cs, server, name, replicas):
+    """Create the TPUServe and seed its route table with fake replicas
+    (key -> _Replica), bypassing discovery — no kubelet in these tests."""
+    cs.tpuserves().create(TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task="echo", checkpoint="v1", replicas=len(replicas),
+            batching=BatchingPolicy(
+                max_batch_size=8, batch_timeout_ms=5.0, queue_limit=64
+            ),
+        ),
+    ))
+    state = server.state_for("default", name)
+    for i, key in enumerate(replicas):
+        state.table.observe(key, float(i))  # earlier keys route first
+    return state
+
+
+class TestDispatchRecovery:
+    def test_midflight_crash_reroutes_to_survivor(self, gw, monkeypatch):
+        cs, server, metrics = gw
+        dead = _Replica(_crash)
+        live = _Replica(lambda p: {"echo": p})
+        monkeypatch.setattr(gw_mod, "lookup_replica", {
+            "default/r-dead": dead, "default/r-live": live,
+        }.get)
+        state = make_state(cs, server, "reroute",
+                           ["default/r-dead", "default/r-live"])
+        out = server.dispatch("default", "reroute", "default", 7.0, 5.0)
+        assert out == {"echo": 7.0}
+        assert dead.calls == 1 and live.calls == 1
+        assert metrics.get_counter("tfk8s_gateway_retries_total", {
+            "serve": "default/reroute", "tenant": "default",
+            "reason": "transport",
+        }) == 1.0
+        # the crash fed the health machine
+        assert state.table.health_state("default/r-dead") == H.SUSPECT
+
+    def test_retry_budget_exhaustion_is_typed_with_tried(self, gw, monkeypatch):
+        cs, server, _ = gw
+        dead = _Replica(_crash)
+        monkeypatch.setattr(
+            gw_mod, "lookup_replica", {"default/r-dead": dead}.get
+        )
+        state = make_state(cs, server, "budget", ["default/r-dead"])
+        state.retry_budget = _NoBudget()
+        with pytest.raises(ReplicaUnavailable, match="retry budget exhausted"):
+            server.dispatch("default", "budget", "default", 1.0, 5.0)
+        assert dead.calls == 1  # budget denied before any second attempt
+
+    def test_retry_cap_bounds_attempts(self, gw, monkeypatch):
+        cs, server, _ = gw
+        a, b = _Replica(_crash), _Replica(_crash)
+        monkeypatch.setattr(gw_mod, "lookup_replica", {
+            "default/r-a": a, "default/r-b": b,
+        }.get)
+        make_state(cs, server, "cap", ["default/r-a", "default/r-b"])
+        with pytest.raises(ReplicaUnavailable) as ei:
+            server.dispatch("default", "cap", "default", 1.0, 5.0)
+        assert a.calls + b.calls == MAX_DISPATCH_RETRIES + 1
+        assert len(ei.value.tried) == MAX_DISPATCH_RETRIES + 1
+        assert set(ei.value.tried) == {"default/r-a", "default/r-b"}
+
+    def test_vanished_replica_counts_removal_and_reroutes(self, gw, monkeypatch):
+        cs, server, metrics = gw
+        live = _Replica(lambda p: {"echo": p})
+        # r-gone has a route-table entry but NO registry entry: the
+        # in-flight request discovers the silent removal
+        monkeypatch.setattr(
+            gw_mod, "lookup_replica", {"default/r-live": live}.get
+        )
+        make_state(cs, server, "gone", ["default/r-gone", "default/r-live"])
+        out = server.dispatch("default", "gone", "default", 3.0, 5.0)
+        assert out == {"echo": 3.0}
+        assert metrics.get_counter("tfk8s_gateway_replica_removed_total", {
+            "serve": "default/gone", "reason": "ejected",
+        }) == 1.0
+
+
+class TestWireEnvelopes:
+    def raw_post(self, server, path, payload):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            conn.request("POST", path, body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def test_504_names_tried_replicas_in_details(self, gw, monkeypatch):
+        """Satellite (c): deadline exhaustion mid-dispatch surfaces as a
+        typed 504 whose Status details NAME the replicas tried — the
+        operator sees where the deadline went, not just that it went."""
+        cs, server, _ = gw
+
+        def die(payload):
+            raise DeadlineExceeded("deadline died on the replica")
+
+        slow = _Replica(die)
+        monkeypatch.setattr(
+            gw_mod, "lookup_replica", {"default/r-slow": slow}.get
+        )
+        make_state(cs, server, "slow", ["default/r-slow"])
+        status, body = self.raw_post(
+            server, "/v1/serve/default/slow", {"payload": 1.0, "timeoutS": 5.0}
+        )
+        assert status == 504
+        assert body["reason"] == "DeadlineExceeded"
+        assert body["details"]["triedReplicas"] == ["default/r-slow"]
+
+    def test_503_budget_exhaustion_names_tried_replicas(self, gw, monkeypatch):
+        cs, server, _ = gw
+        dead = _Replica(_crash)
+        monkeypatch.setattr(
+            gw_mod, "lookup_replica", {"default/r-dead": dead}.get
+        )
+        state = make_state(cs, server, "dead", ["default/r-dead"])
+        state.retry_budget = _NoBudget()
+        status, body = self.raw_post(
+            server, "/v1/serve/default/dead", {"payload": 1.0, "timeoutS": 5.0}
+        )
+        assert status == 503
+        assert body["reason"] == "Unavailable"
+        assert body["details"]["triedReplicas"] == ["default/r-dead"]
+
+
+class _FakeGateway:
+    """Raw-socket stand-in that garbles the FIRST connection's first
+    response frame (bad Content-Length, stale bytes left on the wire)
+    and serves valid frames on every later connection/request."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.accepted = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.accepted += 1
+            threading.Thread(
+                target=self._serve_conn, args=(conn, self.accepted == 1),
+                daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn, garble):
+        reader = conn.makefile("rb")
+        try:
+            while True:
+                line = reader.readline()
+                if not line:
+                    return
+                clen = 0
+                while True:
+                    h = reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    if h.lower().startswith(b"content-length"):
+                        clen = int(h.split(b":")[1])
+                reader.read(clen)
+                if garble:
+                    garble = False
+                    # keep the connection OPEN with unread junk queued:
+                    # a client that fails to drop the socket would hand
+                    # these bytes to its NEXT request as the status line
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Length: banana\r\n\r\nSTALEBYTES"
+                    )
+                    continue
+                body = json.dumps({"result": {"ok": True}}).encode()
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: %d\r\n\r\n%s"
+                    % (len(body), body)
+                )
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self.sock.close()
+
+
+class TestClientReconnectHygiene:
+    def test_garbled_frame_drops_warm_socket(self):
+        """Satellite (a): a response the client cannot frame leaves
+        unread bytes on the warm socket — reusing it would feed the next
+        request the previous response. The client must reconnect."""
+        fake = _FakeGateway()
+        client = GatewayClient(f"http://127.0.0.1:{fake.port}", "s")
+        try:
+            assert client.request(1.0, timeout=5) == {"ok": True}
+            assert fake.accepted == 2, "garbled frame must drop the socket"
+            # and once healthy the warm socket pipelines again
+            assert client.request(2.0, timeout=5) == {"ok": True}
+            assert fake.accepted == 2
+        finally:
+            client.close()
+            fake.close()
+
+    def test_unreachable_gateway_is_typed_after_bounded_retries(self):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()  # nothing listens here now
+        client = GatewayClient(f"http://127.0.0.1:{port}", "s")
+        with pytest.raises(Unavailable, match="unreachable"):
+            client.request(1.0, timeout=5)
+        client.close()

@@ -1,0 +1,309 @@
+"""Replica failure domains in the gateway (ISSUE 13): the per-replica
+health state machine (gateway/health.py) and its RouteTable integration
+— Healthy → Suspect → Ejected → half-open probe re-admit, driven by
+dispatch-observed outcomes. Everything here runs on a fake clock; no
+sockets, no sleeps."""
+
+import pytest
+
+from tfk8s_tpu.gateway import health as H
+from tfk8s_tpu.gateway.router import RouteTable
+from tfk8s_tpu.utils.logging import Metrics
+
+
+class FakeClock:
+    def __init__(self, now=100.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def table(clock, **kw):
+    kw.setdefault("metrics", Metrics())
+    return RouteTable(clientset=None, name="s", clock=clock, **kw)
+
+
+A, B, C = "default/p-a", "default/p-b", "default/p-c"
+
+
+def seed(t, *keys):
+    for k in keys:
+        t.observe(k, 0.0)
+
+
+class TestReplicaHealthUnit:
+    def test_starts_healthy(self):
+        h = H.ReplicaHealth()
+        assert h.state == H.HEALTHY
+        assert h.routable(0.0)
+
+    def test_one_transport_error_suspects(self):
+        h = H.ReplicaHealth()
+        assert h.note_transport_error() == "suspect"
+
+    def test_consecutive_errors_escalate_to_eject(self):
+        h = H.ReplicaHealth()
+        verdicts = [h.note_transport_error() for _ in range(H.EJECT_AFTER_ERRORS)]
+        assert verdicts[-1] == "eject"
+
+    def test_ok_resets_the_error_streak(self):
+        h = H.ReplicaHealth()
+        h.note_transport_error()
+        h.note_transport_error()
+        h.note_ok(0.01, 0.5)
+        assert h.state == H.HEALTHY
+        assert h.consec_errors == 0
+        # the streak restarts from scratch
+        assert h.note_transport_error() == "suspect"
+
+    def test_deadline_ratio_ejects_only_with_enough_samples(self):
+        h = H.ReplicaHealth()
+        # below the sample floor: suspect, never eject
+        for _ in range(H.DEADLINE_MIN_SAMPLES - 1):
+            assert h.note_deadline() in ("suspect", None)
+        assert h.note_deadline() == "eject"
+
+    def test_deadline_ratio_tolerates_sparse_timeouts(self):
+        h = H.ReplicaHealth()
+        # 1 deadline among many oks: ratio stays under the eject bar
+        for _ in range(H.DEADLINE_WINDOW - 1):
+            h.note_ok(0.01, 0.5)
+        assert h.note_deadline() != "eject"
+
+    def test_gray_requires_samples_floor_and_margin(self):
+        h = H.ReplicaHealth()
+        for _ in range(H.GRAY_MIN_SAMPLES):
+            h.note_ok(0.2, 0.5)
+        assert H.is_gray(h, fleet_median_s=0.01)
+        # no peers -> median 0 -> never gray
+        assert not H.is_gray(h, fleet_median_s=0.0)
+        # fast replica is never gray even vs an even-faster median
+        fast = H.ReplicaHealth()
+        for _ in range(H.GRAY_MIN_SAMPLES):
+            fast.note_ok(H.GRAY_FLOOR_S / 10, 0.5)
+        assert not H.is_gray(fast, fleet_median_s=1e-4)
+
+    def test_probe_failure_escalates_cooldown_capped(self):
+        h = H.ReplicaHealth()
+        h.eject(0.0)
+        first = h.cooldown_s
+        for _ in range(20):
+            h.eject(0.0, escalate=True)
+        assert h.cooldown_s > first
+        assert h.cooldown_s <= H.EJECT_COOLDOWN_MAX_S
+
+    def test_ejected_routable_only_after_cooldown_with_probe_slot(self):
+        h = H.ReplicaHealth()
+        h.eject(10.0)
+        assert not h.routable(10.0 + h.cooldown_s / 2)
+        assert h.routable(10.0 + h.cooldown_s + 0.01)
+        h.probe_inflight = H.PROBE_MAX_INFLIGHT
+        assert not h.routable(10.0 + h.cooldown_s + 0.01)
+
+
+class TestRouteTableEjection:
+    def test_transport_errors_eject_and_count(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics)
+        seed(t, A, B)
+        for _ in range(H.EJECT_AFTER_ERRORS):
+            t.report_outcome(A, "transport_error")
+        assert t.health_state(A) == H.EJECTED
+        assert t.pick() == B
+        assert [k for k, _ in t.targets()] == [B]
+        assert metrics.get_counter(
+            "tfk8s_gateway_ejections_total",
+            {"serve": "default/s", "reason": "errors"},
+        ) == 1.0
+
+    def test_single_transport_error_only_suspects(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A, B)
+        t.report_outcome(A, "transport_error")
+        assert t.health_state(A) == H.SUSPECT
+        # suspect still carries traffic, just deprioritized: equal depth
+        # now routes to the healthy peer
+        assert t.pick() == B
+
+    def test_availability_floor_degrades_last_replica_to_suspect(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A)
+        for _ in range(H.EJECT_AFTER_ERRORS * 2):
+            t.report_outcome(A, "transport_error")
+        assert t.health_state(A) == H.SUSPECT
+        assert t.pick() == A  # still routable: never below 1 replica
+
+    def test_floor_reopens_when_a_peer_arrives(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A)
+        for _ in range(H.EJECT_AFTER_ERRORS):
+            t.report_outcome(A, "transport_error")
+        assert t.health_state(A) == H.SUSPECT
+        seed(t, B)
+        for _ in range(H.EJECT_AFTER_ERRORS):
+            t.report_outcome(A, "transport_error")
+        assert t.health_state(A) == H.EJECTED
+
+    def test_deadline_ratio_ejects_replica(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics)
+        seed(t, A, B)
+        for _ in range(H.DEADLINE_MIN_SAMPLES):
+            t.report_outcome(A, "deadline")
+        assert t.health_state(A) == H.EJECTED
+        assert metrics.get_counter(
+            "tfk8s_gateway_ejections_total",
+            {"serve": "default/s", "reason": "deadline"},
+        ) == 1.0
+
+    def test_gray_replica_ejected_by_latency_vs_fleet_median(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics)
+        seed(t, A, B, C)
+        for _ in range(H.GRAY_MIN_SAMPLES):
+            t.report_outcome(A, "ok", 0.5)   # gray: alive but slow
+            t.report_outcome(B, "ok", 0.005)
+            t.report_outcome(C, "ok", 0.005)
+        assert t.health_state(A) == H.EJECTED
+        assert metrics.get_counter(
+            "tfk8s_gateway_ejections_total",
+            {"serve": "default/s", "reason": "gray"},
+        ) == 1.0
+
+    def test_uniformly_slow_fleet_is_not_gray(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A, B, C)
+        for _ in range(H.GRAY_MIN_SAMPLES):
+            for k in (A, B, C):
+                t.report_outcome(k, "ok", 0.5)
+        assert all(t.health_state(k) == H.HEALTHY for k in (A, B, C))
+
+
+class TestHalfOpenProbe:
+    def eject(self, t, key):
+        for _ in range(H.EJECT_AFTER_ERRORS):
+            t.report_outcome(key, "transport_error")
+        assert t.health_state(key) == H.EJECTED
+
+    def test_probe_readmits_on_success(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A, B)
+        self.eject(t, A)
+        # load B so A would win on depth were it routable
+        for _ in range(4):
+            assert t.pick() == B
+        clock.advance(H.EJECT_COOLDOWN_S + 0.01)
+        probe = t.pick()
+        assert probe == A
+        t.report_outcome(A, "ok", 0.005)
+        t.release(A)
+        assert t.health_state(A) == H.HEALTHY
+        assert A in [k for k, _ in t.targets()]
+
+    def test_circuit_bounds_concurrent_probes(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A, B)
+        self.eject(t, A)
+        for _ in range(4):
+            t.pick()  # pile depth on B
+        clock.advance(H.EJECT_COOLDOWN_S + 0.01)
+        assert t.pick() == A          # the single half-open probe
+        assert t.pick() == B          # second pick must NOT probe A too
+        t.release(A)                  # probe slot returns with the lease
+        assert t.pick() == A
+
+    def test_failed_probe_reejects_with_longer_cooldown(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics)
+        seed(t, A, B)
+        self.eject(t, A)
+        clock.advance(H.EJECT_COOLDOWN_S + 0.01)
+        assert t.pick() == A
+        t.report_outcome(A, "transport_error")
+        t.release(A)
+        assert t.health_state(A) == H.EJECTED
+        assert metrics.get_counter(
+            "tfk8s_gateway_ejections_total",
+            {"serve": "default/s", "reason": "probe"},
+        ) == 1.0
+        # cooldown doubled: the original window no longer re-admits
+        clock.advance(H.EJECT_COOLDOWN_S + 0.01)
+        assert t.pick() == B
+        clock.advance(H.EJECT_COOLDOWN_S)
+        assert t.pick() in (A, B)  # eventually probes again
+
+
+class TestRemovalAccounting:
+    def test_stale_aging_counts_removal(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics, stale_after_s=1.0)
+        seed(t, A, B)
+        clock.advance(0.5)
+        t.observe(B, 0.0)
+        clock.advance(0.6)
+        assert t.pick() == B
+        assert metrics.get_counter(
+            "tfk8s_gateway_replica_removed_total",
+            {"serve": "default/s", "reason": "stale"},
+        ) == 1.0
+
+    def test_drain_counts_removal(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics)
+        seed(t, A, B)
+        t.mark_draining(A)
+        assert [k for k, _ in t.targets()] == [B]
+        assert metrics.get_counter(
+            "tfk8s_gateway_replica_removed_total",
+            {"serve": "default/s", "reason": "drained"},
+        ) == 1.0
+
+    def test_inflight_discovery_counts_ejected_removal(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        t = table(clock, metrics=metrics)
+        seed(t, A, B)
+        t.remove(A)  # dispatch found the registry entry gone mid-flight
+        assert [k for k, _ in t.targets()] == [B]
+        assert metrics.get_counter(
+            "tfk8s_gateway_replica_removed_total",
+            {"serve": "default/s", "reason": "ejected"},
+        ) == 1.0
+
+    def test_last_pick_survives_removal(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A)
+        assert t.pick() == A
+        stamp = t.last_pick_s(A)
+        assert stamp == pytest.approx(clock.now)
+        t.release(A)
+        t.remove(A)
+        # the chaos bench reads kill->last-routed after the pod is gone
+        assert t.last_pick_s(A) == stamp
+
+    def test_least_depth_ignores_ejected(self):
+        clock = FakeClock()
+        t = table(clock)
+        seed(t, A, B)
+        t.observe(B, 50.0)
+        for _ in range(H.EJECT_AFTER_ERRORS):
+            t.report_outcome(A, "transport_error")
+        assert t.least_depth() is not None
+        assert t.least_depth() > 1.0  # B's depth, not ejected A's 0

@@ -72,6 +72,12 @@ def test_default_scope_covers_hotpath_counters():
         # the tracing e2e key off these exact names
         "tfk8s_serving_ttft_seconds": False,
         "tfk8s_trace_spans_dropped_total": False,
+        # ISSUE-13 fault-tolerance series: the chaos bench arm and the
+        # health/containment tests key off these exact names
+        "tfk8s_gateway_ejections_total": False,
+        "tfk8s_gateway_retries_total": False,
+        "tfk8s_gateway_replica_removed_total": False,
+        "tfk8s_serving_rows_quarantined_total": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

@@ -89,6 +89,12 @@ class GatewayClient:
     """
 
     OVERLOAD_BACKOFF_S = 0.05
+    # bounded transport-failure retries (gateway unreachable, 503 with
+    # nothing routable): the gateway already re-dispatches around a dead
+    # replica internally, so the client's policy is a small, jittered
+    # second chance — not an amplifier
+    TRANSPORT_RETRIES = 2
+    TRANSPORT_BACKOFF_S = 0.02
 
     def __init__(self, url: str, name: str, namespace: str = "default",
                  tenant: str = "", timeout_s: float = 30.0):
@@ -195,7 +201,17 @@ class GatewayClient:
             headers[name.decode("latin-1").strip().lower()] = (
                 value.decode("latin-1").strip()
             )
-        n = int(headers.get("content-length", "0") or "0")
+        try:
+            n = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            # a garbled frame leaves unread body bytes on the socket —
+            # surfacing it as a connection error makes _roundtrip DROP
+            # the warm socket instead of handing the next request on
+            # this thread the previous response's stale bytes
+            raise ConnectionResetError(
+                "bad Content-Length from gateway: "
+                f"{headers.get('content-length')!r}"
+            )
         data = reader.read(n) if n else b""
         if len(data) < n:
             raise ConnectionResetError("connection closed mid-body")
@@ -214,6 +230,8 @@ class GatewayClient:
         annotate the span with typed ``retry`` events."""
         deadline = time.monotonic() + timeout
         shed_backoff = self.OVERLOAD_BACKOFF_S
+        transport_backoff = self.TRANSPORT_BACKOFF_S
+        transport_retries = 0
         attempt = 0
         with get_tracer().start_span(
             "gateway.client.request",
@@ -234,6 +252,18 @@ class GatewayClient:
                         body, traceparent=span.traceparent
                     )
                 except OSError as exc:
+                    if transport_retries < self.TRANSPORT_RETRIES:
+                        transport_retries += 1
+                        delay = jittered_backoff(None, transport_backoff)
+                        if delay < deadline - time.monotonic():
+                            span.add_event("retry", {
+                                "attempt": attempt,
+                                "reason": "transport",
+                                "backoff_s": delay,
+                            })
+                            time.sleep(delay)
+                            transport_backoff = min(transport_backoff * 2, 0.5)
+                            continue
                     raise Unavailable(f"gateway unreachable: {exc}") from exc
                 if status == 200:
                     span.set_attribute("http.status_code", 200)
@@ -262,6 +292,24 @@ class GatewayClient:
                         })
                         time.sleep(delay)
                         shed_backoff = min(shed_backoff * 2, 1.0)
+                        continue
+                elif (isinstance(err, Unavailable)
+                        and transport_retries < self.TRANSPORT_RETRIES):
+                    # 503: a replica died mid-flight with the gateway's
+                    # retry budget drained, or nothing was routable —
+                    # both transient while the controller replaces the
+                    # replica, so give it the same bounded second chance
+                    transport_retries += 1
+                    delay = jittered_backoff(None, transport_backoff)
+                    if delay < deadline - time.monotonic():
+                        span.add_event("retry", {
+                            "attempt": attempt,
+                            "reason": "Unavailable",
+                            "status": status,
+                            "backoff_s": delay,
+                        })
+                        time.sleep(delay)
+                        transport_backoff = min(transport_backoff * 2, 0.5)
                         continue
                 span.set_attribute("http.status_code", status)
                 raise err

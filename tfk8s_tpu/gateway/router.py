@@ -22,15 +22,30 @@ Replica lifecycle in the table:
   drain starts — the gateway subscribes to
   ``runtime.server.add_drain_hook``, which fires when the replica
   unregisters, BEFORE the kubelet would publish anything — preserving
-  the zero-failed-request rollout contract on the wire path.
+  the zero-failed-request rollout contract on the wire path;
+- **health ejection** (ISSUE 13, gateway/health.py): the dispatch loop
+  feeds per-replica outcomes back through ``report_outcome`` — Healthy
+  → Suspect → Ejected → half-open probe re-admit, driven by consecutive
+  transport errors, the deadline-exceeded ratio, and the gray-failure
+  latency detector. An UNPLANNED failure (crash, wire cut, slow box) is
+  therefore discovered actively, well before passive stale aging; the
+  availability floor degrades the last routable replica to
+  Suspect-with-traffic instead of ejecting it.
+
+Every removal — stale-aged, drain-purged, or discovered vanished by an
+in-flight request — is counted in
+``tfk8s_gateway_replica_removed_total{reason=stale|drained|ejected}``;
+ejections in ``tfk8s_gateway_ejections_total{reason}``.
 """
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from tfk8s_tpu.gateway import health as _health
 from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.trainer.serve_controller import EMA_ALPHA
 from tfk8s_tpu.utils.logging import get_logger
@@ -44,11 +59,12 @@ CACHE_TTL_S = 0.25
 
 
 class _Entry:
-    __slots__ = ("depth", "seen")
+    __slots__ = ("depth", "seen", "health")
 
     def __init__(self, depth: float, seen: float):
         self.depth = depth
         self.seen = seen
+        self.health = _health.ReplicaHealth()
 
 
 class RouteTable:
@@ -82,6 +98,9 @@ class RouteTable:
         # the pod is gone from every discovery source)
         self._draining: Dict[str, float] = {}
         self._last_refresh = 0.0
+        # key -> clock stamp of the last pick (kept past removal: the
+        # chaos bench reads kill->last-routed as ejection_time_ms)
+        self._last_pick: Dict[str, float] = {}
 
     # -- feeds ---------------------------------------------------------------
 
@@ -107,10 +126,17 @@ class RouteTable:
         with self._lock:
             if key not in self._entries and key not in self._draining:
                 return
-            self._entries.pop(key, None)
+            self._removed_locked(key, "drained")
             self._draining[key] = now
-        log.debug("%s/%s: %s draining; removed from route table",
-                  self.namespace, self.name, key)
+
+    def remove(self, key: str, reason: str = "ejected") -> None:
+        """Drop a replica an in-flight request DISCOVERED gone (its
+        registry entry vanished mid-dispatch) — counted in the removal
+        metric so a vanished replica is visible without a debugger."""
+        with self._lock:
+            if key not in self._entries:
+                return
+            self._removed_locked(key, reason)
 
     def refresh(self, force: bool = False) -> None:
         """Re-discover Ready replicas and their published depths through
@@ -140,11 +166,15 @@ class RouteTable:
     # -- routing -------------------------------------------------------------
 
     def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
-        """Least effective depth (published EMA + local in-flight) among
-        fresh, non-draining, non-excluded replicas; leases an in-flight
-        slot on the winner. None when nothing is routable."""
+        """Least effective depth (published EMA + local in-flight +
+        Suspect penalty) among fresh, non-draining, non-excluded,
+        ROUTABLE replicas; leases an in-flight slot on the winner. An
+        Ejected replica is routable only as a half-open probe (cooldown
+        elapsed, probe circuit open) — the pick leases its probe slot.
+        None when nothing is routable."""
         self.refresh()
         now = self._clock()
+        probe = False
         with self._lock:
             self._purge_locked(now)
             best: Optional[str] = None
@@ -152,16 +182,28 @@ class RouteTable:
             for key in sorted(self._entries):  # sorted: deterministic ties
                 if exclude and key in exclude:
                     continue
-                d = self._entries[key].depth + self._inflight.get(key, 0)
+                e = self._entries[key]
+                if not e.health.routable(now):
+                    continue
+                d = (
+                    e.depth + self._inflight.get(key, 0)
+                    + e.health.depth_penalty()
+                )
                 if best is None or d < best_depth:
                     best, best_depth = key, d
             if best is not None:
+                h = self._entries[best].health
+                if h.state == _health.EJECTED:
+                    probe = True
+                    h.probe_inflight += 1
                 self._inflight[best] = self._inflight.get(best, 0) + 1
+                self._last_pick[best] = now
         if best is not None:
             span = get_tracer().current_span()
             if span is not None:
                 span.add_event("route.pick", {
                     "replica": best, "effective_depth": best_depth,
+                    **({"probe": True} if probe else {}),
                 })
         return best
 
@@ -172,6 +214,74 @@ class RouteTable:
                 self._inflight.pop(key, None)
             else:
                 self._inflight[key] = n - 1
+            e = self._entries.get(key)
+            if e is not None and e.health.probe_inflight > 0:
+                e.health.probe_inflight -= 1  # half-open probe slot back
+
+    def report_outcome(self, key: str, outcome: str,
+                       latency_s: Optional[float] = None) -> None:
+        """Dispatch feedback driving the health state machine. One call
+        per dispatched attempt: ``outcome`` is ``"ok"`` (with the
+        replica-observed latency), ``"transport_error"`` (connection
+        failed / replica vanished / crashed mid-flight) or
+        ``"deadline"`` (the caller's deadline died on this replica).
+        Ejections honor the availability floor: the last routable
+        replica degrades to Suspect-with-traffic instead."""
+        now = self._clock()
+        reason: Optional[str] = None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            h = e.health
+            if outcome == "ok":
+                was_ejected = h.state == _health.EJECTED
+                h.note_ok(latency_s, EMA_ALPHA)
+                if was_ejected:
+                    log.info("%s/%s: probe of %s succeeded; re-admitted",
+                             self.namespace, self.name, key)
+                elif (
+                    _health.is_gray(h, self._fleet_median_locked(key))
+                    and self._floor_allows_locked(key)
+                ):
+                    h.eject(now)
+                    reason = "gray"
+            else:
+                verdict = (
+                    h.note_transport_error()
+                    if outcome == "transport_error" else h.note_deadline()
+                )
+                if verdict == "suspect":
+                    h.state = _health.SUSPECT
+                elif verdict == "eject":
+                    if self._floor_allows_locked(key):
+                        h.eject(now)
+                        reason = (
+                            "errors" if outcome == "transport_error"
+                            else "deadline"
+                        )
+                    else:
+                        # availability floor: never eject the last
+                        # routable replica — degraded but serving beats
+                        # nothing routable at all
+                        h.state = _health.SUSPECT
+                elif verdict == "reeject":
+                    h.eject(now, escalate=True)
+                    reason = "probe"
+        if reason is not None:
+            if self._metrics is not None:
+                self._metrics.inc(
+                    "tfk8s_gateway_ejections_total", 1.0,
+                    {"serve": f"{self.namespace}/{self.name}",
+                     "reason": reason},
+                )
+            span = get_tracer().current_span()
+            if span is not None:
+                span.add_event("replica.eject", {
+                    "replica": key, "reason": reason,
+                })
+            log.warning("%s/%s: ejected %s (%s)",
+                        self.namespace, self.name, key, reason)
 
     def least_depth(self) -> float:
         """The least effective depth across routable replicas (inf when
@@ -183,27 +293,72 @@ class RouteTable:
             depths = [
                 e.depth + self._inflight.get(k, 0)
                 for k, e in self._entries.items()
+                if e.health.state != _health.EJECTED
             ]
         return min(depths) if depths else float("inf")
 
     def targets(self) -> List[Tuple[str, float]]:
-        """Routable (key, effective depth) pairs — debug/test surface."""
+        """Routable (key, effective depth) pairs — debug/test surface
+        and the gauge feed. Ejected replicas are out of the routing set
+        (half-open probes aside) and don't list."""
         now = self._clock()
         with self._lock:
             self._purge_locked(now)
             return sorted(
                 (k, e.depth + self._inflight.get(k, 0))
                 for k, e in self._entries.items()
+                if e.health.state != _health.EJECTED
             )
 
+    def health_state(self, key: str) -> Optional[str]:
+        """The replica's health state (health.HEALTHY/SUSPECT/EJECTED),
+        or None when it left the table."""
+        with self._lock:
+            e = self._entries.get(key)
+            return e.health.state if e is not None else None
+
+    def last_pick_s(self, key: str) -> Optional[float]:
+        """Clock stamp of the LAST pick of ``key`` (kept past removal):
+        kill-to-last-pick is the chaos bench's ``ejection_time_ms``."""
+        with self._lock:
+            return self._last_pick.get(key)
+
     # -- internals -----------------------------------------------------------
+
+    def _floor_allows_locked(self, key: str) -> bool:
+        """Availability floor: ejecting ``key`` must leave at least one
+        routable (non-Ejected) replica."""
+        return any(
+            k != key and e.health.state != _health.EJECTED
+            for k, e in self._entries.items()
+        )
+
+    def _fleet_median_locked(self, key: str) -> float:
+        """Median latency EWMA of ``key``'s PEERS (non-ejected, with
+        data) — excluding the candidate so one slow replica can't drag
+        the gray-detection reference toward itself."""
+        peers = [
+            e.health.latency_ewma
+            for k, e in self._entries.items()
+            if k != key and e.health.latency_ewma is not None
+            and e.health.state != _health.EJECTED
+        ]
+        return statistics.median(peers) if peers else 0.0
+
+    def _removed_locked(self, key: str, reason: str) -> None:
+        self._entries.pop(key, None)
+        if self._metrics is not None:
+            self._metrics.inc(
+                "tfk8s_gateway_replica_removed_total", 1.0,
+                {"serve": f"{self.namespace}/{self.name}", "reason": reason},
+            )
+        log.debug("%s/%s: %s removed from route table (%s)",
+                  self.namespace, self.name, key, reason)
 
     def _purge_locked(self, now: float) -> None:
         for key, e in list(self._entries.items()):
             if now - e.seen > self._stale_after:
-                del self._entries[key]
-                log.debug("%s/%s: %s aged out of route table",
-                          self.namespace, self.name, key)
+                self._removed_locked(key, "stale")
         for key, when in list(self._draining.items()):
             if now - when > self._stale_after:
                 del self._draining[key]

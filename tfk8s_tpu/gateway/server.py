@@ -17,8 +17,12 @@ the wire; shed responses ALWAYS carry Retry-After)::
     429 QuotaExceeded     the TENANT's bucket/concurrency budget
     429 Overloaded        cluster pressure (priority shed or replica queue)
     500 RequestFailed     model raised executing the batch
-    503 Unavailable       no routable replica held until the deadline
+    503 Unavailable       no routable replica held until the deadline, OR
+                          a replica failed mid-flight with the retry
+                          budget exhausted (retriable by the caller)
     504 DeadlineExceeded  deadline elapsed while queued/executing
+                          (``details.triedReplicas`` names the replicas
+                          the dispatch loop burned the deadline on)
 
 ``Retry-After`` uses fractional seconds (e.g. ``0.087``): sub-second
 backoff is the natural timescale of a batching queue and this is our
@@ -38,6 +42,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from tfk8s_tpu.client.ratelimit import TokenBucketRateLimiter
 from tfk8s_tpu.client.store import NotFound, Unavailable
 from tfk8s_tpu.gateway.admission import TenantAdmission
 from tfk8s_tpu.gateway.router import RouteTable
@@ -49,6 +54,7 @@ from tfk8s_tpu.runtime.server import (
     InvalidRequest,
     Overloaded,
     QuotaExceeded,
+    ReplicaUnavailable,
     ServeError,
     lookup_replica,
 )
@@ -63,6 +69,13 @@ DEFAULT_TENANT = "default"
 MAX_TIMEOUT_S = 120.0
 # Retry-After when a replica shed without a hint of its own
 DEFAULT_RETRY_AFTER_S = 0.1
+# in-flight recovery (ISSUE 13): transport-class re-dispatch attempts
+# per request, AND a per-serve token bucket bounding the fleet-wide
+# retry rate — a dying fleet must not amplify offered load into a
+# retry storm
+MAX_DISPATCH_RETRIES = 3
+RETRY_BUDGET_QPS = 20.0
+RETRY_BUDGET_BURST = 40
 
 
 def _err_body(status: int, reason: str, message: str,
@@ -105,12 +118,23 @@ def _wire_error(exc: Exception) -> Tuple[int, str, Dict[str, Any], Dict[str, str
     if isinstance(exc, NotFound):
         return 404, "NotFound", {}, headers
     if isinstance(exc, Unavailable):
-        return 503, "Unavailable", {}, headers
+        return 503, "Unavailable", _tried_details(exc), headers
     if isinstance(exc, DeadlineExceeded):
-        return 504, "DeadlineExceeded", {}, headers
+        return 504, "DeadlineExceeded", _tried_details(exc), headers
+    if isinstance(exc, ReplicaUnavailable):
+        # transport-class: the replica died mid-flight and the retry
+        # budget ran out — retriable by the caller, NOT a model failure
+        return 503, "Unavailable", _tried_details(exc), headers
     # Draining should be absorbed by the dispatch loop; RequestFailed and
     # any other ServeError are the model's failure, a plain 500
     return 500, "RequestFailed", {}, headers
+
+
+def _tried_details(exc: Exception) -> Dict[str, Any]:
+    """The replicas the dispatch loop burned the deadline on, for the
+    Status envelope details — pinned by tests/test_gateway_faults.py."""
+    tried = getattr(exc, "tried", None)
+    return {"triedReplicas": list(tried)} if tried else {}
 
 
 def debug_requests(tracer, inflight: Optional[list] = None,
@@ -342,13 +366,19 @@ class _ServeState:
     """Per-TPUServe routing + admission, plus the TTL-cached spec bits
     the hot path needs (queue limit, tenancy)."""
 
-    __slots__ = ("table", "admission", "queue_limit", "fetched")
+    __slots__ = ("table", "admission", "queue_limit", "fetched",
+                 "retry_budget")
 
     def __init__(self, table: RouteTable):
         self.table = table
         self.admission = TenantAdmission()
         self.queue_limit = 0
         self.fetched = 0.0
+        # transport-failure re-dispatches debit this bucket (fleet-wide
+        # per serve) — exhausted means the failure surfaces typed
+        self.retry_budget = TokenBucketRateLimiter(
+            RETRY_BUDGET_QPS, RETRY_BUDGET_BURST
+        )
 
 
 class GatewayServer(ThreadingHTTPServer):
@@ -390,6 +420,22 @@ class GatewayServer(ThreadingHTTPServer):
             metrics.describe(
                 "tfk8s_gateway_route_depth",
                 "Least effective queue depth across routable replicas.",
+            )
+            metrics.describe(
+                "tfk8s_gateway_ejections_total",
+                "Replicas ejected from the routing set by the health "
+                "state machine, by reason "
+                "(errors/deadline/gray/probe).",
+            )
+            metrics.describe(
+                "tfk8s_gateway_retries_total",
+                "In-flight re-dispatches to a surviving replica, by "
+                "reason (draining/transport).",
+            )
+            metrics.describe(
+                "tfk8s_gateway_replica_removed_total",
+                "Replicas removed from the route table, by reason "
+                "(stale/drained/ejected).",
             )
         self.stopping = threading.Event()
         self._states: Dict[Tuple[str, str], _ServeState] = {}
@@ -493,11 +539,23 @@ class GatewayServer(ThreadingHTTPServer):
         state.admission.configure(serve.spec.tenancy)
         return state
 
+    def _count_retry(self, serve: str, tenant: str, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc("tfk8s_gateway_retries_total", 1.0, {
+                "serve": serve, "tenant": tenant, "reason": reason,
+            })
+
     def dispatch(self, namespace: str, name: str, tenant: str,
                  payload: Any, timeout: float) -> Any:
-        """Admit, route least-loaded, submit; absorb Draining/vanished
-        replicas by re-routing inside the deadline."""
+        """Admit, route least-loaded, submit; absorb Draining, vanished,
+        and CRASHED replicas by re-routing to a survivor inside the
+        deadline. A serve request is idempotent (a pure function of its
+        payload), so a mid-flight transport failure is retriable —
+        bounded per request by MAX_DISPATCH_RETRIES and fleet-wide by
+        the serve's token-bucket retry budget. Every attempt's outcome
+        feeds the router's health state machine."""
         state = self.state_for(namespace, name)
+        serve_label = f"{namespace}/{name}"
         deadline = time.monotonic() + timeout
         t0 = time.perf_counter()
         # the handler's root span is ambient on this thread; its context
@@ -511,14 +569,18 @@ class GatewayServer(ThreadingHTTPServer):
         )
         try:
             exclude: set = set()
+            tried: list = []
+            transport_retries = 0
             backoff = 0.005
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise DeadlineExceeded(
-                        f"no replica of {namespace}/{name} served the "
+                    exc = DeadlineExceeded(
+                        f"no replica of {serve_label} served the "
                         f"request within {timeout}s"
                     )
+                    exc.tried = list(tried)
+                    raise exc
                 key = state.table.pick(exclude)
                 if key is None:
                     if exclude:
@@ -527,31 +589,45 @@ class GatewayServer(ThreadingHTTPServer):
                     if timeout - remaining + backoff > timeout * 0.5:
                         # half the deadline burned with NOTHING routable:
                         # surface it as capacity, not a deadline miss
-                        raise Unavailable(
-                            f"{namespace}/{name}: no routable replica"
+                        exc = Unavailable(
+                            f"{serve_label}: no routable replica"
                         )
+                        exc.tried = list(tried)
+                        raise exc
                     time.sleep(min(backoff, remaining))
                     backoff = min(backoff * 2, 0.25)
                     continue
                 server = lookup_replica(key)
                 if server is None:
+                    # an in-flight request just DISCOVERED the replica's
+                    # registry entry is gone — count the removal (it was
+                    # silent before) and route around it
                     state.table.release(key)
+                    state.table.remove(key, "ejected")
+                    if span is not None:
+                        span.add_event("replica.vanished", {"replica": key})
                     exclude.add(key)
                     continue
+                submit_t0 = time.perf_counter()
                 try:
                     if self.metrics is not None:
                         self.metrics.observe(
                             "tfk8s_gateway_queue_seconds",
                             time.perf_counter() - t0,
-                            {"serve": f"{namespace}/{name}"},
+                            {"serve": serve_label},
                         )
-                    return server.submit(
+                    result = server.submit(
                         payload, timeout=remaining, traceparent=traceparent,
                         tenant=tenant, priority=priority,
                     )
+                    state.table.report_outcome(
+                        key, "ok", time.perf_counter() - submit_t0
+                    )
+                    return result
                 except Draining:
                     # rolling out from under us — retry the next-least-
                     # loaded replica (the zero-failed-request contract)
+                    self._count_retry(serve_label, tenant, "draining")
                     if span is not None:
                         span.add_event("retry", {
                             "reason": "Draining", "replica": key,
@@ -559,6 +635,36 @@ class GatewayServer(ThreadingHTTPServer):
                         })
                     exclude.add(key)
                     continue
+                except DeadlineExceeded as exc:
+                    # the deadline died ON this replica: feed the health
+                    # machine (ratio-based eject) and surface it typed
+                    state.table.report_outcome(key, "deadline")
+                    tried.append(key)
+                    exc.tried = list(tried)
+                    raise
+                except (ReplicaUnavailable, OSError) as exc:
+                    # the replica died mid-flight (crash, wire cut,
+                    # connection reset) — retriable on a survivor while
+                    # the deadline, attempt cap, and budget allow
+                    state.table.report_outcome(key, "transport_error")
+                    tried.append(key)
+                    exclude.add(key)
+                    transport_retries += 1
+                    if (transport_retries <= MAX_DISPATCH_RETRIES
+                            and state.retry_budget.try_accept()):
+                        self._count_retry(serve_label, tenant, "transport")
+                        if span is not None:
+                            span.add_event("retry", {
+                                "reason": "ReplicaUnavailable",
+                                "replica": key,
+                            })
+                        continue
+                    wrapped = ReplicaUnavailable(
+                        f"{serve_label}: replica {key} failed mid-flight "
+                        f"({exc}) with the retry budget exhausted"
+                    )
+                    wrapped.tried = list(tried)
+                    raise wrapped from exc
                 finally:
                     state.table.release(key)
         finally:

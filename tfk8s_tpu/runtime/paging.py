@@ -91,6 +91,14 @@ class PageAllocator:
         # page with refcount 0 is idle storage, evictable on demand
         self._cache: "OrderedDict[str, int]" = OrderedDict()
         self._page_key: Dict[int, str] = {}
+        # fault containment (ISSUE 13): pages held by a FAULTED row are
+        # never returned to the free list until verified — a poisoned
+        # page must not carry corrupt K/V into a future admission.
+        # _quarantined = unreferenced pages awaiting verification;
+        # _tainted = poisoned pages still shared with a live lease
+        # (diverted into _quarantined at their final release)
+        self._quarantined: set = set()
+        self._tainted: set = set()
         self.prefix_hits = 0
         self.prefix_misses = 0
 
@@ -229,10 +237,63 @@ class PageAllocator:
                 self._ref[pid] = n
                 continue
             self._ref.pop(pid, None)
+            if pid in self._tainted:
+                self._tainted.discard(pid)
+                self._quarantined.add(pid)
+                continue
             if pid not in self._page_key:
                 self._free.append(pid)
         lease.pages = []
         lease.cached_pages = 0
+
+    # -- fault quarantine ---------------------------------------------------
+
+    @property
+    def quarantined_pages(self) -> int:
+        """Pages held out of circulation pending verification (includes
+        tainted pages still pinned by a live lease)."""
+        return len(self._quarantined) + len(self._tainted)
+
+    def quarantine(self, lease: SlotLease) -> int:
+        """Retire a FAULTED lease: return its unused reservation, but
+        hold every page it touched OUT of the free list (and unpublish
+        them from the prefix cache) until :meth:`verify_quarantined`
+        clears them. A page still shared with another live lease stays
+        readable for that lease (its content predates the fault) but is
+        tainted — it quarantines at its final release instead of going
+        free. Returns the number of pages quarantined or tainted."""
+        self._reserved_total -= lease.reserved
+        lease.reserved = 0
+        n_held = 0
+        for pid in lease.pages:
+            key = self._page_key.pop(pid, None)
+            if key is not None:
+                self._cache.pop(key, None)
+            n = self._ref.get(pid, 0) - 1
+            if n > 0:
+                self._ref[pid] = n
+                if pid not in self._tainted:
+                    self._tainted.add(pid)
+                    n_held += 1
+                continue
+            self._ref.pop(pid, None)
+            if pid not in self._quarantined:
+                self._quarantined.add(pid)
+                n_held += 1
+        lease.pages = []
+        lease.cached_pages = 0
+        return n_held
+
+    def verify_quarantined(self) -> int:
+        """Release verified quarantined pages back to the free list (the
+        pool's K/V pages are fully overwritten by prefill before any row
+        reads them, so verification is an explicit operator/executor
+        decision, never implicit). Tainted pages still pinned by live
+        leases stay tainted. Returns the number of pages returned."""
+        n = len(self._quarantined)
+        while self._quarantined:
+            self._free.append(self._quarantined.pop())
+        return n
 
     def _evict_idle(self) -> None:
         """Reclaim the LRU idle cached page into the free list. Called

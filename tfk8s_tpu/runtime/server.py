@@ -129,6 +129,23 @@ class DeadlineExceeded(ServeError, TimeoutError):
     working, while the serve paths now only raise the ServeError tree."""
 
 
+class ReplicaUnavailable(RequestFailed, ConnectionError):
+    """The replica died (or dropped the connection) while it held the
+    request — a transport-class failure of an accepted-but-unanswered,
+    idempotent serve request. Unlike a plain :class:`RequestFailed` (the
+    request's own execution raised), NOTHING about the request itself is
+    suspect: it is safe to re-dispatch to a survivor, which is exactly
+    what the gateway does under its retry budget. Subclasses
+    :class:`ConnectionError` so transport-level handlers catch it too."""
+
+
+class RowFault(RequestFailed):
+    """A fault attributable to ONE decode row — poisoned pages, a
+    malformed continuation (out-of-vocab token off the device), a
+    per-row device fault. Crash containment retires THAT row typed and
+    quarantines its pages; sibling rows keep decoding untouched."""
+
+
 # ---------------------------------------------------------------------------
 # Served models
 # ---------------------------------------------------------------------------
@@ -445,6 +462,14 @@ class PagedGptDecoder:
     def max_len(self) -> int:
         return self._cfg.max_len
 
+    @property
+    def vocab_size(self) -> int:
+        """Bound on legal token ids — the decode loop's per-row sanity
+        check: an out-of-range token off the device means THAT row's
+        state is corrupt (poisoned pages / per-row device fault), which
+        crash containment retires typed instead of failing the world."""
+        return self._cfg.vocab_size
+
     def validate(self, payload: Any):
         """Normalize a payload into ``(tokens int32 [plen], gen_budget)``.
         Payloads are a 1-D int token array, or a dict ``{"tokens": ...,
@@ -581,6 +606,10 @@ class DecodeLoopExecutor:
         from tfk8s_tpu.runtime.paging import PageAllocator
 
         self.model = model
+        # vocab bound for the per-row malformed-continuation check; a
+        # decoder that declares none (test doubles) skips the upper
+        # bound — negative tokens are malformed regardless
+        self._vocab_bound = getattr(model, "vocab_size", None)
         self.queue_limit = max(1, int(queue_limit))
         self.metrics = metrics if metrics is not None else get_metrics()
         self.labels = dict(labels or {})
@@ -615,7 +644,20 @@ class DecodeLoopExecutor:
         # state straight back
         self._d_state = None
         self._state_dirty = True
+        # fault containment (ISSUE 13): a non-None fault means a GLOBAL
+        # failure (device unusable) — the loop is dead, submits refuse
+        # with retriable ReplicaUnavailable, report_progress goes
+        # non-Ready and the serve controller replaces the pod
+        self._fault: Optional[BaseException] = None
+        # chaos hooks (tests/chaos.py): poisoned prompt keys whose next
+        # decoded token is corrupted to an out-of-vocab id (the hermetic
+        # per-row device fault), and an injected submit latency (gray)
+        self._chaos_poison: set = set()
+        self._chaos_delay_s = 0.0
         for name, help_text in (
+            ("tfk8s_serving_rows_quarantined_total",
+             "Decode rows retired by per-row fault containment; their "
+             "pages are quarantined until verified."),
             ("tfk8s_serving_tokens_total",
              "Generated tokens, counted per decode iteration."),
             ("tfk8s_serving_tpot_seconds",
@@ -701,12 +743,16 @@ class DecodeLoopExecutor:
                 {**self.labels, "outcome": "invalid"},
             )
             raise
+        if self._chaos_delay_s:
+            time.sleep(self._chaos_delay_s)  # gray replica: alive but slow
         req = _GenRequest(
             tokens=tokens, gen_budget=gen, enqueue_t=time.perf_counter(),
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
         )
         with self._cond:
+            if self._fault is not None:
+                raise ReplicaUnavailable(f"replica failed: {self._fault}")
             if self._draining or self._stopped:
                 raise Draining("replica is draining; retry another replica")
             if len(self._q) >= self.queue_limit:
@@ -747,6 +793,10 @@ class DecodeLoopExecutor:
                 )
             raise DeadlineExceeded(f"request not served within {timeout}s")
         if req.error is not None:
+            if isinstance(req.error, ServeError):
+                # already typed (RowFault, ReplicaUnavailable, ...):
+                # surface AS IS — retriability must survive the hop
+                raise req.error
             raise RequestFailed(str(req.error)) from req.error
         return req.result
 
@@ -799,8 +849,13 @@ class DecodeLoopExecutor:
                     self._prefill_admitted(admitted)
                 if self._live:
                     self._decode_once()
-            except BaseException as e:  # noqa: BLE001 — fan the failure out
-                self._fail_all(e)
+            except BaseException as e:  # noqa: BLE001 — a GLOBAL fault:
+                # per-row faults were already contained inside the step
+                # (_retire_failed); anything that escapes means the
+                # device itself is unusable — fail the world and exit
+                # non-Ready so the serve controller replaces the replica
+                self._fatal(e)
+                return
             self._update_occupancy_gauges()
 
     def _pages_for(self, slot: _Slot, upto_tokens: int) -> None:
@@ -918,19 +973,33 @@ class DecodeLoopExecutor:
         self._d_state = state_dev
         self.batches_total += 1
         self._occupancy_sum += len(live)
-        self.tokens_total += len(live)
         self.metrics.inc("tfk8s_serving_batches_total", 1.0, self.labels)
-        self.metrics.inc(
-            "tfk8s_serving_tokens_total", float(len(live)), self.labels
-        )
         self.metrics.set_gauge(
             "tfk8s_serving_batch_occupancy", self.mean_batch_occupancy,
             self.labels,
         )
         step_t = time.perf_counter()  # one stamp per step, shared by rows
+        emitted = 0
         for i in live:
             slot = self._slots[i]
+            if slot is None:
+                continue  # a chaos crash raced the step and cleared it
             tok = int(nxt[i])
+            if self._chaos_poison:
+                tok = self._apply_chaos_poison(slot, tok)
+            if tok < 0 or (
+                self._vocab_bound is not None and tok >= self._vocab_bound
+            ):
+                # crash containment: a malformed continuation indicts
+                # THIS row's state only — retire it typed, quarantine
+                # its pages, keep every sibling row decoding
+                self._retire_failed(slot, RowFault(
+                    f"row {slot.idx} emitted malformed token {tok} "
+                    f"(vocab {self._vocab_bound}) at position "
+                    f"{slot.position}; row retired, pages quarantined"
+                ))
+                continue
+            emitted += 1
             slot.position += 1
             slot.last_token = tok
             slot.req.out.append(tok)
@@ -940,6 +1009,11 @@ class DecodeLoopExecutor:
                 self.model.eos_id is not None and tok == self.model.eos_id
             ):
                 self._retire(slot)
+        self.tokens_total += emitted
+        if emitted:
+            self.metrics.inc(
+                "tfk8s_serving_tokens_total", float(emitted), self.labels
+            )
 
     def _retire(self, slot: _Slot) -> None:
         """Complete a finished request and free its pages — the slot is
@@ -1063,6 +1137,35 @@ class DecodeLoopExecutor:
             events=events,
         )
 
+    def _retire_failed(self, slot: _Slot, exc: ServeError) -> None:
+        """Crash containment: retire ONE faulted row without failing the
+        world. Its request fails typed (:class:`RowFault`, a
+        RequestFailed), its pages are QUARANTINED — never returned to
+        the free list (or the prefix cache) until explicitly verified,
+        so a poisoned page can't carry corrupt K/V into a future
+        admission — and every sibling row keeps decoding (each row's
+        paged attention reads only its own page table, so isolation is
+        exact; test-pinned bit-identical siblings)."""
+        now = time.perf_counter()
+        req = slot.req
+        with self._cond:
+            held = self.allocator.quarantine(slot.lease)
+            self._slots[self._slots.index(slot)] = None
+            self._live -= 1
+            self._state_dirty = True  # the faulted row must stop stepping
+        self.metrics.inc(
+            "tfk8s_serving_rows_quarantined_total", 1.0, self.labels
+        )
+        self.metrics.inc(
+            "tfk8s_serving_requests_total", 1.0,
+            {**self.labels, "outcome": "error"},
+        )
+        log.warning("decode row fault (%d page(s) quarantined): %s", held, exc)
+        if req.traceparent:
+            self._emit_request_span(req, now, error=str(exc))
+        req.error = exc
+        req.done.set()
+
     def _fail_all(self, e: BaseException) -> None:
         """A device-step failure poisons every in-flight request (the
         ModelServer batch-failure contract, extended to live slots)."""
@@ -1085,6 +1188,93 @@ class DecodeLoopExecutor:
             if slot.req.traceparent:
                 self._emit_request_span(slot.req, now, error=str(e))
             slot.req.done.set()
+
+    def _fail_queued(self, e: BaseException) -> None:
+        """Fail every QUEUED (accepted-but-unstarted) request with ``e``
+        — the other half of a whole-replica failure; live slots go
+        through :meth:`_fail_all`."""
+        with self._cond:
+            victims = list(self._q)
+            self._q.clear()
+            self.metrics.set_gauge(
+                "tfk8s_serving_queue_depth", 0.0, self.labels
+            )
+        if victims:
+            self.metrics.inc(
+                "tfk8s_serving_requests_total", float(len(victims)),
+                {**self.labels, "outcome": "error"},
+            )
+        for req in victims:
+            req.error = e
+            req.done.set()
+
+    def _fatal(self, e: BaseException) -> None:
+        """A genuinely GLOBAL fault (device unusable): mark the replica
+        faulted — submits now refuse with retriable
+        :class:`ReplicaUnavailable`, ``report_progress`` reports
+        non-Ready so the entrypoint exits and the serve controller
+        replaces the pod — and fail everything the replica holds with
+        the same retriable error (the request rode a dying replica;
+        nothing about the request itself is suspect, so the gateway
+        re-dispatches it to a survivor)."""
+        wrapped = (
+            e if isinstance(e, ReplicaUnavailable)
+            else ReplicaUnavailable(f"replica failed: {e}")
+        )
+        if not isinstance(e, ReplicaUnavailable):
+            wrapped.__cause__ = e
+        with self._cond:
+            self._fault = e
+            self._cond.notify_all()
+        log.error("decode loop fatal fault, replica exiting non-Ready: %s", e)
+        self._fail_all(wrapped)
+        self._fail_queued(wrapped)
+
+    @property
+    def fault(self) -> Optional[BaseException]:
+        """The global fault that killed the loop, if any (the serve
+        entrypoint polls this and exits non-Ready on it)."""
+        return self._fault
+
+    # -- chaos hooks (tests/chaos.py; never on the production path) ----------
+
+    def chaos_crash(self, message: str = "chaos: replica host died") -> None:
+        """Simulate the replica's HOST dying mid-generation: every held
+        request fails retriable-ReplicaUnavailable, new submits refuse
+        with the same, and the replica goes non-Ready. The registry
+        entry is NOT removed — a dead host can't unregister; discovery
+        (gateway health ejection, stale aging) is what stops traffic."""
+        self._fatal(ReplicaUnavailable(message))
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    def chaos_wire_reset(self, message: str = "chaos: wire reset") -> None:
+        """Fail every accepted-but-unanswered request ONCE with
+        retriable ReplicaUnavailable — the replica stays healthy and
+        keeps serving (a dropped connection, not a dead host)."""
+        err = ReplicaUnavailable(message)
+        self._fail_all(err)
+        self._fail_queued(err)
+
+    def chaos_delay(self, seconds: float) -> None:
+        """Gray failure: every subsequent submit stalls ``seconds``
+        before enqueueing — alive and correct, but slow (the failure
+        mode the gateway's latency-EWMA detector must catch)."""
+        self._chaos_delay_s = max(0.0, float(seconds))
+
+    def chaos_poison_row(self, tokens: Any) -> None:
+        """Arm a per-row fault: the request whose prompt matches
+        ``tokens`` emits a malformed (out-of-vocab) token on its next
+        decode step — the hermetic simulation of poisoned pages."""
+        self._chaos_poison.add(tuple(int(t) for t in tokens))
+
+    def _apply_chaos_poison(self, slot: _Slot, tok: int) -> int:
+        key = tuple(int(t) for t in slot.req.tokens)
+        if key in self._chaos_poison:
+            self._chaos_poison.discard(key)
+            return -1
+        return tok
 
     def _update_occupancy_gauges(self) -> None:
         self.metrics.set_gauge(
@@ -1141,7 +1331,7 @@ class DecodeLoopExecutor:
         qps = (self.served_total - last_served) / dt if dt > 0 else 0.0
         self._qps_last = (now, self.served_total)
         values = {
-            "serving_ready": 1.0,
+            "serving_ready": 0.0 if self._fault is not None else 1.0,
             "serving_queue_depth": float(self.queue_depth),
             "serving_qps": qps,
             "serving_batch_occupancy": self.mean_batch_occupancy,
@@ -1264,6 +1454,10 @@ class ModelServer:
         self.batches_total = 0
         self.rejected_total = 0
         self._qps_last = (time.monotonic(), 0)
+        # fault containment / chaos hooks — the DecodeLoopExecutor
+        # surface, mirrored so every replica kind can crash in tests
+        self._fault: Optional[BaseException] = None
+        self._chaos_delay_s = 0.0
         for name, help_text in (
             ("tfk8s_serving_requests_total",
              "Serving requests by outcome (ok / rejected / error)."),
@@ -1339,12 +1533,16 @@ class ModelServer:
                 {**self.labels, "outcome": "invalid"},
             )
             raise
+        if self._chaos_delay_s:
+            time.sleep(self._chaos_delay_s)  # gray replica: alive but slow
         req = _Request(
             payload=payload, bucket=bucket, enqueue_t=time.perf_counter(),
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
         )
         with self._cond:
+            if self._fault is not None:
+                raise ReplicaUnavailable(f"replica failed: {self._fault}")
             if self._draining or self._stopped:
                 raise Draining("replica is draining; retry another replica")
             if len(self._q) >= self.queue_limit:
@@ -1389,8 +1587,54 @@ class ModelServer:
                 )
             raise DeadlineExceeded(f"request not served within {timeout}s")
         if req.error is not None:
+            if isinstance(req.error, ServeError):
+                raise req.error  # typed; retriability survives the hop
             raise RequestFailed(str(req.error)) from req.error
         return req.result
+
+    # -- chaos hooks (tests/chaos.py; never on the production path) ----------
+
+    @property
+    def fault(self) -> Optional[BaseException]:
+        return self._fault
+
+    def _fail_queued(self, e: BaseException) -> None:
+        with self._cond:
+            victims = list(self._q)
+            self._q.clear()
+            self.metrics.set_gauge(
+                "tfk8s_serving_queue_depth", 0.0, self.labels
+            )
+            self._cond.notify_all()
+        if victims:
+            self.metrics.inc(
+                "tfk8s_serving_requests_total", float(len(victims)),
+                {**self.labels, "outcome": "error"},
+            )
+        for req in victims:
+            req.error = e
+            req.done.set()
+
+    def chaos_crash(self, message: str = "chaos: replica host died") -> None:
+        """Host death: queued requests fail retriable-ReplicaUnavailable,
+        new submits refuse with the same, report_progress goes
+        non-Ready; the registry entry stays (a dead host can't
+        unregister — discovery is what stops traffic)."""
+        err = ReplicaUnavailable(message)
+        with self._cond:
+            self._fault = err
+            self._stopped = True
+            self._cond.notify_all()
+        self._fail_queued(err)
+
+    def chaos_wire_reset(self, message: str = "chaos: wire reset") -> None:
+        """Fail accepted-but-unanswered (queued) requests once with
+        retriable ReplicaUnavailable; the replica keeps serving."""
+        self._fail_queued(ReplicaUnavailable(message))
+
+    def chaos_delay(self, seconds: float) -> None:
+        """Gray failure: every subsequent submit stalls ``seconds``."""
+        self._chaos_delay_s = max(0.0, float(seconds))
 
     # -- the batcher --------------------------------------------------------
 
@@ -1539,7 +1783,7 @@ class ModelServer:
         qps = (self.served_total - last_served) / dt if dt > 0 else 0.0
         self._qps_last = (now, self.served_total)
         values = {
-            "serving_ready": 1.0,
+            "serving_ready": 0.0 if self._fault is not None else 1.0,
             "serving_queue_depth": float(self.queue_depth),
             "serving_qps": qps,
             "serving_batch_occupancy": self.mean_batch_occupancy,
@@ -1599,6 +1843,21 @@ def replica_keys() -> List[str]:
     """Every registered replica key (the /debug/decode enumeration)."""
     with _registry_lock:
         return sorted(_REPLICAS)
+
+
+def chaos_crash_replica(key: str,
+                        message: str = "chaos: replica host died") -> bool:
+    """Chaos entry (tests/chaos.py kill_replica): crash a registered
+    replica WITHOUT unregistering it — the corpse stays in the registry
+    and route tables keep offering it until the gateway's health
+    machinery ejects it, which is exactly what a crashed host looks like
+    from the serving plane. Returns False when ``key`` isn't
+    registered."""
+    server = lookup_replica(key)
+    if server is None:
+        return False
+    server.chaos_crash(message)
+    return True
 
 
 # How often the serving entrypoint refreshes its progress report. The
@@ -1681,8 +1940,19 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
     log.info("%s: serving %s (%s) ready; version=%s", key, task, checkpoint,
              model.version)
     reclaimed = False
+    fault: Optional[BaseException] = None
     try:
         while not stop.wait(PROGRESS_PERIOD_S):
+            # a GLOBAL fault (device unusable) exits non-Ready WITHOUT
+            # the drain protocol: a crashed host can't unregister — the
+            # registry keeps the corpse and discovery (gateway health
+            # ejection, stale aging) stops traffic; the raised error
+            # FAILs the pod so the serve controller replaces it
+            fault = getattr(server, "fault", None)
+            if fault is not None:
+                log.error("%s: replica fault, exiting non-Ready: %s",
+                          key, fault)
+                break
             # a reclaim notice (runtime/kubelet.py PodStopSignal) is an
             # immediate graceful exit for a serving replica: there is no
             # step to finish — unregister now so the client routes away,
@@ -1694,15 +1964,20 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
                 break
             server.report_progress()
     finally:
-        # drain order matters: unregister FIRST so the client stops
-        # picking this replica, then finish what it already holds —
-        # a rolling update never fails an accepted request
-        unregister_replica(key)
-        drained = server.drain(
-            timeout=float(env.get("TFK8S_SERVE_DRAIN_TIMEOUT_S", "30"))
-        )
-        log.info("%s: drained=%s after %d requests in %d batches",
-                 key, drained, server.served_total, server.batches_total)
+        if fault is not None:
+            server.report_progress()  # publish serving_ready 0.0
+        else:
+            # drain order matters: unregister FIRST so the client stops
+            # picking this replica, then finish what it already holds —
+            # a rolling update never fails an accepted request
+            unregister_replica(key)
+            drained = server.drain(
+                timeout=float(env.get("TFK8S_SERVE_DRAIN_TIMEOUT_S", "30"))
+            )
+            log.info("%s: drained=%s after %d requests in %d batches",
+                     key, drained, server.served_total, server.batches_total)
+    if fault is not None:
+        raise ServeError(f"{key}: replica fault: {fault}")
     if reclaimed:
         from tfk8s_tpu.runtime.registry import PodDrained
 
@@ -1808,6 +2083,16 @@ class ServeClient:
                     })
                 refresh = True
                 continue
+            except ReplicaUnavailable:
+                # the replica died holding the request — idempotent serve,
+                # safe to re-dispatch to a survivor inside the deadline
+                if span is not None:
+                    span.add_event("retry", {
+                        "attempt": attempt, "reason": "ReplicaUnavailable",
+                        "replica": key, "backoff_s": 0.0,
+                    })
+                refresh = True
+                continue
             except Overloaded as exc:
                 delay = jittered_backoff(exc.retry_after_s, shed_backoff)
                 if delay >= deadline - time.monotonic():
@@ -1855,11 +2140,14 @@ __all__ = [
     "Overloaded",
     "PagedGptDecoder",
     "QuotaExceeded",
+    "ReplicaUnavailable",
     "RequestFailed",
+    "RowFault",
     "ServeClient",
     "ServeError",
     "ServedModel",
     "add_drain_hook",
+    "chaos_crash_replica",
     "jittered_backoff",
     "make_model",
     "register_replica",

@@ -36,6 +36,7 @@ SCOPE = (
     "tfk8s_tpu/gateway/router.py",
     "tfk8s_tpu/gateway/admission.py",
     "tfk8s_tpu/gateway/client.py",
+    "tfk8s_tpu/gateway/health.py",
 )
 
 SEED_ROOTS = {
