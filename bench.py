@@ -1329,6 +1329,281 @@ def _gen_serving_probe(small: bool, full: bool = False):
     }
 
 
+def _disagg_serving_probe(small: bool, full: bool = False):
+    """Disaggregated prefill/decode serving (ISSUE 14), two claims:
+
+    A) PREFIX AFFINITY: multi-turn chat sessions whose page-aligned
+       history grows every turn, routed to a prefill pool either by the
+       real consistent-hash affinity ring (gateway/affinity.py) or by
+       depth-only scatter (uniform spread — what least-loaded does to a
+       session under uniform load). Reported: prompt tokens each policy
+       actually re-prefilled (prompt length minus the replica's cached
+       prefix pages, probed via ``allocator.match_prefix`` at the moment
+       of routing) and the saved fraction — the driver's
+       ``affinity_reprefill_saved`` acceptance key.
+
+    B) BURST ISOLATION: long-lived decode streams share a plane with a
+       burst of long-prompt admissions. Split pools (prefill replica +
+       decode replica, KV page handoff across the seam) keep the burst's
+       chunked prefill off the decode loop — the streams' p99 TPOT is
+       compared against a shared pool of the SAME total replica count
+       where burst prefill chunks interleave with live decode steps.
+
+    Both parts run the real executors end-to-end (submit_prefill ->
+    LocalKVTransport -> submit_handoff), so every affinity-arm number
+    already pays the handoff serialize/verify/import tax."""
+    import numpy as np
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tfk8s_tpu.gateway.affinity import AffinityRing, affinity_key_of
+    from tfk8s_tpu.runtime.handoff import LocalKVTransport
+    from tfk8s_tpu.runtime.server import DecodeLoopExecutor, PagedGptDecoder
+    from tfk8s_tpu.utils.logging import Metrics
+
+    small_mode = small and not full
+    # Page geometry note: the prefix cache only publishes pages covering
+    # a PROPER prefix of the prompt (the final token is always re-run),
+    # so every turn re-prefills its growth plus one page — a small page
+    # relative to the turn growth keeps the affine arm's floor low.
+    if small_mode:
+        size, vocab = "tiny", 64
+        slots, page_size, max_pages, chunk = 8, 4, 1024, 8
+        n_sessions, turns, prefix_len = 6, 5, 24
+        turn_gen, user_len = 4, 4        # +8/turn: stays page-aligned
+        live_n, live_len, live_gen = 6, 8, 48
+        burst_n, burst_len, burst_gen = 48, 56, 2
+        burst_pace_s = 0.002
+    else:
+        size, vocab = "mid", 256
+        slots, page_size, max_pages, chunk = 8, 8, 1024, 16
+        n_sessions, turns, prefix_len = 8, 8, 48
+        turn_gen, user_len = 8, 8        # +16/turn: stays page-aligned
+        live_n, live_len, live_gen = 6, 16, 96
+        burst_n, burst_len, burst_gen = 32, 96, 2
+        burst_pace_s = 0.02
+    n_prefill = 4
+    rounds = 3
+
+    def mk():
+        dec = PagedGptDecoder(
+            "seed:0", slots=slots, page_size=page_size, max_pages=max_pages,
+            gen_tokens=live_gen, size=size, prefill_chunk=chunk,
+        )
+        dec.load()
+        return DecodeLoopExecutor(
+            dec, queue_limit=128, metrics=Metrics()
+        ).start()
+
+    names = [f"p{i}" for i in range(n_prefill)]
+    prefills = {n: mk() for n in names}
+    decode = mk()
+    transport = LocalKVTransport()
+    ring = AffinityRing()
+    for n in names:
+        ring.add(n)
+    handoff_ns = {"n": 0, "bytes": 0, "s": 0.0}
+
+    def two_phase(prefill_ex, payload, timeout=600.0):
+        pre = prefill_ex.submit_prefill(payload, timeout=timeout)
+        t0 = time.perf_counter()
+        moved, nbytes = transport.transfer(pre["handoff"])
+        handoff_ns["s"] += time.perf_counter() - t0
+        handoff_ns["n"] += 1
+        handoff_ns["bytes"] += nbytes
+        return decode.submit_handoff(moved, timeout=timeout)
+
+    try:
+        # -- part A: re-prefilled tokens, affinity vs scatter --------------
+        # Distinct session content per arm so the shared executors' prefix
+        # caches can't leak one arm's pages into the other.
+        def run_sessions(pick, seed_base):
+            prefilled = 0
+            for s in range(n_sessions):
+                rng = np.random.default_rng(seed_base + s)
+                hist = rng.integers(1, vocab, size=prefix_len).astype(np.int32)
+                for t in range(turns):
+                    ex = prefills[pick(s, t, hist)]
+                    _pages, cached_tok = ex.allocator.match_prefix(hist)
+                    prefilled += len(hist) - cached_tok
+                    out = two_phase(
+                        ex, {"tokens": hist, "gen_tokens": turn_gen}
+                    )
+                    user = rng.integers(1, vocab, size=user_len)
+                    hist = np.concatenate([
+                        hist, np.asarray(out["tokens"], np.int32),
+                        user.astype(np.int32),
+                    ])
+            return prefilled
+
+        scatter_prefilled = run_sessions(
+            lambda s, t, hist: names[(s + t) % n_prefill], 1000
+        )
+        affine_prefilled = run_sessions(
+            lambda s, t, hist: ring.owner(
+                affinity_key_of(hist, page_size)
+            ), 2000
+        )
+        saved = (
+            round(1.0 - affine_prefilled / scatter_prefilled, 3)
+            if scatter_prefilled else None
+        )
+
+        # -- part B: live-stream decode TPOT under a prompt burst ----------
+        # Equal replica counts per arm: disagg = 1 prefill + 1 decode,
+        # shared = 2 do-everything replicas. Fresh random prompts every
+        # round so neither part A's pages nor the previous round's can
+        # subsidize an arm. TPOT is the DECODE-phase cadence in both
+        # arms — time after the first token over the remaining tokens —
+        # so prefill-queue wait (a TTFT cost by construction) can't
+        # contaminate the cadence comparison. The burst is OPEN-LOOP
+        # paced (real arrivals, not an instantaneous dump), and the
+        # whole comparison runs under one shortened GIL switch interval:
+        # at the default 5 ms slice a saturated sibling thread quantizes
+        # every cross-thread step handoff to the slice length on the
+        # 1-core box, drowning both arms in scheduler noise. Arms
+        # interleave across rounds; the median round is reported.
+        rng = np.random.default_rng(3000)
+        settle_s = 0.01
+
+        def tpot_arm(live_one, burst_one):
+            live_prompts = [
+                rng.integers(1, vocab, size=live_len).astype(np.int32)
+                for _ in range(live_n)
+            ]
+            burst_prompts = [
+                rng.integers(1, vocab, size=burst_len).astype(np.int32)
+                for _ in range(burst_n)
+            ]
+            with ThreadPoolExecutor(max_workers=live_n + burst_n) as pool:
+                t0 = time.perf_counter()
+                live = [
+                    pool.submit(live_one, p) for p in live_prompts
+                ]
+                # just long enough for the streams to admit — the burst
+                # must land while they are mid-generation
+                time.sleep(settle_s)
+                tb = time.perf_counter()
+                burst = []
+                for i, p in enumerate(burst_prompts):
+                    target = tb + i * burst_pace_s
+                    now = time.perf_counter()
+                    if target > now:
+                        time.sleep(target - now)
+                    burst.append(pool.submit(burst_one, i, p))
+                for f in burst:
+                    f.result()
+                tpots = sorted(f.result() for f in live)
+                wall = time.perf_counter() - t0
+            return {
+                "tpot_p50_ms": round(tpots[len(tpots) // 2] * 1000, 3),
+                "tpot_p99_ms": round(tpots[-1] * 1000, 3),
+                "wall_s": round(wall, 3),
+            }
+
+        def disagg_live(p):
+            # decode cadence = handoff-admission to retirement over the
+            # locally generated tokens (the first came from prefill)
+            pre = prefills["p0"].submit_prefill(
+                {"tokens": p, "gen_tokens": live_gen}, timeout=600
+            )
+            moved, _nb = transport.transfer(pre["handoff"])
+            t0 = time.perf_counter()
+            decode.submit_handoff(moved, timeout=600)
+            return (time.perf_counter() - t0) / (live_gen - 1)
+
+        def disagg_burst(_i, p):
+            two_phase(prefills["p0"], {"tokens": p, "gen_tokens": burst_gen})
+
+        shared = [prefills["p1"], prefills["p2"]]
+        live_rr = {"i": 0}
+
+        def shared_live(p):
+            ex = shared[live_rr["i"] % 2]
+            live_rr["i"] += 1
+            t0 = time.perf_counter()
+            out = ex.submit(
+                {"tokens": p, "gen_tokens": live_gen}, timeout=600
+            )
+            lat = time.perf_counter() - t0
+            ttft = out.get("ttft_s") or 0.0
+            return (lat - ttft) / (live_gen - 1)
+
+        def shared_burst(i, p):
+            shared[i % 2].submit(
+                {"tokens": p, "gen_tokens": burst_gen}, timeout=600
+            )
+
+        # compile-warm every shape on every replica before timing
+        for plen in (live_len, burst_len):
+            two_phase(prefills["p0"], {
+                "tokens": np.ones(plen, np.int32), "gen_tokens": 2,
+            })
+            for ex in shared:
+                ex.submit({
+                    "tokens": np.ones(plen, np.int32), "gen_tokens": 2,
+                }, timeout=600)
+
+        import sys as _sys
+
+        old_switch = _sys.getswitchinterval()
+        _sys.setswitchinterval(0.0005)
+        try:
+            sh_rounds, dg_rounds = [], []
+            for _ in range(rounds):
+                sh_rounds.append(tpot_arm(shared_live, shared_burst))
+                dg_rounds.append(tpot_arm(disagg_live, disagg_burst))
+        finally:
+            _sys.setswitchinterval(old_switch)
+
+        def med(rs, key):
+            vals = sorted(r[key] for r in rs)
+            return vals[len(vals) // 2]
+
+        sh = {k: med(sh_rounds, k) for k in sh_rounds[0]}
+        dg = {k: med(dg_rounds, k) for k in dg_rounds[0]}
+    finally:
+        for ex in list(prefills.values()) + [decode]:
+            ex.drain(timeout=30)
+
+    return {
+        "disagg_model": f"gpt-{size}",
+        "disagg_page_size": page_size,
+        "disagg_prefill_chunk": chunk,
+        "disagg_prefill_replicas": n_prefill,
+        "disagg_decode_replicas": 1,
+        "disagg_sessions": n_sessions,
+        "disagg_turns": turns,
+        "disagg_prefix_tokens": prefix_len,
+        "scatter_prefilled_tokens": int(scatter_prefilled),
+        "affinity_prefilled_tokens": int(affine_prefilled),
+        "affinity_reprefill_saved": saved,
+        "disagg_handoffs": handoff_ns["n"],
+        "disagg_handoff_bytes_mean": (
+            int(handoff_ns["bytes"] / handoff_ns["n"]) if handoff_ns["n"]
+            else None
+        ),
+        "disagg_handoff_ms_mean": (
+            round(handoff_ns["s"] / handoff_ns["n"] * 1000, 3)
+            if handoff_ns["n"] else None
+        ),
+        "disagg_live_streams": live_n,
+        "disagg_live_gen_tokens": live_gen,
+        "disagg_burst_requests": burst_n,
+        "disagg_burst_prompt_tokens": burst_len,
+        "disagg_tpot_p50_ms": dg["tpot_p50_ms"],
+        "disagg_tpot_p99_ms": dg["tpot_p99_ms"],
+        "disagg_burst_wall_s": dg["wall_s"],
+        "shared_tpot_p50_ms": sh["tpot_p50_ms"],
+        "shared_tpot_p99_ms": sh["tpot_p99_ms"],
+        "shared_burst_wall_s": sh["wall_s"],
+        "disagg_tpot_win": (
+            round(sh["tpot_p99_ms"] / dg["tpot_p99_ms"], 2)
+            if dg["tpot_p99_ms"] else None
+        ),
+    }
+
+
 def _recovery_probe(small: bool, full: bool = False):
     """Elastic recovery time (ISSUE 6): kill 1 of 4 workers mid-epoch
     with a reclaim notice against the REAL job controller + hermetic
@@ -1868,6 +2143,20 @@ def main() -> None:
             print(f"bench: chaos serving probe failed: {exc}", file=sys.stderr)
             degraded.append("chaos_serving")
 
+    # -- disaggregated serving: prefix-affinity re-prefill savings and
+    # burst-isolated decode TPOT vs a shared pool (hermetic) -------------
+    disagg_block = None
+    if os.environ.get("BENCH_DISAGG", "1") == "1":
+        try:
+            disagg_block = _disagg_serving_probe(
+                small, full=os.environ.get("BENCH_DISAGG_FULL") == "1"
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"bench: disagg serving probe failed: {exc}", file=sys.stderr
+            )
+            degraded.append("disagg_serving")
+
     # -- elastic recovery: reclaim-notice -> resized-gang-training time
     # against the real controller + kubelet (hermetic, chip-free) --------
     recovery_block = None
@@ -2085,6 +2374,10 @@ def main() -> None:
                         {"chaos_serving": chaos_block}
                         if chaos_block else {}
                     ),
+                    **(
+                        {"disagg_serving": disagg_block}
+                        if disagg_block else {}
+                    ),
                     **({"recovery": recovery_block} if recovery_block else {}),
                     **(
                         {
@@ -2150,7 +2443,7 @@ def main() -> None:
     print(
         build_headline(
             detail, image_block, detail_name, serving_block, recovery_block,
-            gen_serving_block, gateway_block, chaos_block,
+            gen_serving_block, gateway_block, chaos_block, disagg_block,
         )
     )
 
@@ -2165,7 +2458,7 @@ HEADLINE_MAX_CHARS = 1800
 def build_headline(
     detail: dict, image_block, detail_name, serving_block=None,
     recovery_block=None, gen_serving_block=None, gateway_block=None,
-    chaos_block=None,
+    chaos_block=None, disagg_block=None,
 ) -> str:
     """Assemble the final-stdout headline line from the full detail
     record: the fixed key set, the image-decode and serving rows when
@@ -2282,6 +2575,24 @@ def build_headline(
                 if k in chaos_block
             }
         )
+    if disagg_block:
+        # the disaggregation rows ride the headline: the fraction of
+        # re-prefill tokens prefix-affinity saved over depth-only
+        # scatter, and the live streams' p99 TPOT under a prompt burst
+        # for the split pools vs the shared-pool baseline — the driver's
+        # acceptance keys for the disagg arm
+        headline_extra.update(
+            {
+                k: disagg_block[k]
+                for k in (
+                    "affinity_reprefill_saved",
+                    "disagg_tpot_p99_ms",
+                    "shared_tpot_p99_ms",
+                    "disagg_tpot_win",
+                )
+                if k in disagg_block
+            }
+        )
     if recovery_block:
         # the elastic-recovery rows ride the headline: seconds from a
         # reclaim notice to the RESIZED gang's first post-resize optimizer
@@ -2316,6 +2627,7 @@ def build_headline(
         "gateway_trace_overhead",
         "gateway_wire_efficiency", "gateway_p99_ms",
         "chaos_p99_ms", "ejection_time_ms",
+        "disagg_tpot_win", "shared_tpot_p99_ms",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
         "image_meets_budget", "img_per_sec_native",
@@ -2324,6 +2636,7 @@ def build_headline(
         "chaos_failed_requests",
         "ttft_p99_ms",
         "tpot_p99_ms", "gen_tokens_per_s",
+        "disagg_tpot_p99_ms", "affinity_reprefill_saved",
         "recovery_p99_s", "recovery_p50_s",
         "image_decode_images_per_sec", "bert_base_mlm_step_time_ms",
     ):

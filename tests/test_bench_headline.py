@@ -160,11 +160,32 @@ FULL_RECOVERY_BLOCK = {
 }
 
 
+FULL_DISAGG_BLOCK = {
+    "disagg_model": "gpt-tiny",
+    "disagg_page_size": 8,
+    "disagg_prefill_replicas": 3,
+    "disagg_decode_replicas": 1,
+    "disagg_sessions": 6,
+    "disagg_turns": 6,
+    "scatter_prefilled_tokens": 864,
+    "affinity_prefilled_tokens": 336,
+    "affinity_reprefill_saved": 0.611,
+    "disagg_handoffs": 108,
+    "disagg_handoff_bytes_mean": 18212,
+    "disagg_handoff_ms_mean": 0.41,
+    "disagg_tpot_p50_ms": 9.8,
+    "disagg_tpot_p99_ms": 12.3,
+    "shared_tpot_p50_ms": 21.0,
+    "shared_tpot_p99_ms": 29.4,
+    "disagg_tpot_win": 2.39,
+}
+
+
 def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
         FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
-        FULL_GATEWAY_BLOCK, FULL_CHAOS_BLOCK,
+        FULL_GATEWAY_BLOCK, FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -213,6 +234,15 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert "chaos_victim" not in parsed["extra"]
     assert "chaos_seed" not in parsed["extra"]
     assert "chaos_served" not in parsed["extra"]
+    # ISSUE-14 disaggregation acceptance keys: the re-prefill fraction
+    # affinity saved and the burst-window p99 TPOT for split vs shared
+    assert parsed["extra"]["affinity_reprefill_saved"] == 0.611
+    assert parsed["extra"]["disagg_tpot_p99_ms"] == 12.3
+    assert parsed["extra"]["shared_tpot_p99_ms"] == 29.4
+    # ...the handoff/session accounting stays in the detail record
+    assert "disagg_handoffs" not in parsed["extra"]
+    assert "scatter_prefilled_tokens" not in parsed["extra"]
+    assert "disagg_handoff_bytes_mean" not in parsed["extra"]
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -223,7 +253,7 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     line = bench.build_headline(
         _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
         FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK, FULL_GATEWAY_BLOCK,
-        FULL_CHAOS_BLOCK,
+        FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -242,6 +272,7 @@ def test_headline_without_image_block():
     assert "gen_tokens_per_s" not in parsed["extra"]
     assert "gateway_qps" not in parsed["extra"]
     assert "chaos_failed_requests" not in parsed["extra"]
+    assert "affinity_reprefill_saved" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
@@ -262,5 +293,7 @@ def test_serving_keys_in_drop_order():
                 "gateway_wire_efficiency", "gateway_trace_overhead",
                 "gateway_fairness_ratio",
                 "chaos_failed_requests", "chaos_p99_ms",
-                "ejection_time_ms"):
+                "ejection_time_ms",
+                "affinity_reprefill_saved", "disagg_tpot_p99_ms",
+                "shared_tpot_p99_ms", "disagg_tpot_win"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

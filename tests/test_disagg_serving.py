@@ -1,0 +1,715 @@
+"""Disaggregated prefill/decode serving (ISSUE 14): the consistent-hash
+affinity ring, prefix-affinity routing in the RouteTable, the KV page
+handoff plane (bit-identity pinned against single-replica generation),
+the gateway's two-phase dispatch with session re-pinning, and the
+API/controller surface of the phase-split pools.
+
+Component tests drive a REAL GatewayServer against real tiny-GPT
+decode loops registered as fake replicas (the test_gateway_faults
+pattern), so routing decisions, handoff buffers, and prefix-cache
+counters are all the production code paths — only pod discovery is
+bypassed. The full cluster path (controller renders two labeled pools,
+kubelet runs them, GatewayClient round-trips with a sticky session)
+runs in the slow-marked e2e at the bottom.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import tfk8s_tpu.gateway.server as gw_mod
+from tfk8s_tpu.api.defaults import set_serve_defaults
+from tfk8s_tpu.api.types import (
+    AutoscalePolicy,
+    BatchingPolicy,
+    DisaggregationPolicy,
+    ObjectMeta,
+    TPUServe,
+    TPUServeSpec,
+)
+from tfk8s_tpu.api.validation import validate_serve
+from tfk8s_tpu.client import FakeClientset
+from tfk8s_tpu.gateway.affinity import (
+    AFFINITY_SPILL_DEPTH,
+    AffinityRing,
+    affinity_key_of,
+)
+from tfk8s_tpu.gateway.router import RouteTable
+from tfk8s_tpu.gateway.server import GatewayServer
+from tfk8s_tpu.runtime.handoff import (
+    HandoffError,
+    KVHandoffBuffer,
+    LocalKVTransport,
+)
+from tfk8s_tpu.runtime.server import (
+    DecodeLoopExecutor,
+    PagedGptDecoder,
+    ReplicaUnavailable,
+)
+from tfk8s_tpu.trainer import labels as L
+from tfk8s_tpu.trainer.serve_controller import (
+    _serve_version,
+    render_serve_pod,
+    serve_pools,
+)
+from tfk8s_tpu.utils.logging import Metrics
+
+PAGE = 8
+
+
+def tokens(n, seed=0, hi=64):
+    return np.random.default_rng(seed).integers(1, hi, size=n).astype(np.int32)
+
+
+# -- the affinity ring (pure) ------------------------------------------------
+
+
+class TestAffinityRing:
+    def test_removal_reassigns_only_the_departed_members_keys(self):
+        """THE consistent-hash property (satellite): dropping one member
+        moves exactly the keys it owned — every other key keeps its
+        owner, so an ejection never cold-starts the whole fleet's
+        prefix caches."""
+        ring = AffinityRing()
+        members = [f"default/p-{i}" for i in range(5)]
+        for m in members:
+            ring.add(m)
+        keys = [f"key-{i}" for i in range(500)]
+        before = {k: ring.owner(k) for k in keys}
+        assert len(set(before.values())) == 5  # 64 vnodes spread 500 keys
+        victim = members[2]
+        ring.remove(victim)
+        for k in keys:
+            if before[k] == victim:
+                assert ring.owner(k) != victim
+            else:
+                assert ring.owner(k) == before[k], (
+                    f"{k} moved off a surviving member"
+                )
+
+    def test_candidates_walk_is_distinct_and_owner_first(self):
+        ring = AffinityRing()
+        for m in ("a", "b", "c"):
+            ring.add(m)
+        cands = ring.candidates("some-key")
+        assert cands[0] == ring.owner("some-key")
+        assert sorted(cands) == ["a", "b", "c"]
+
+    def test_describe_fractions_cover_the_key_space(self):
+        ring = AffinityRing()
+        for m in ("a", "b", "c"):
+            ring.add(m)
+        desc = ring.describe()
+        fracs = [v["owned_fraction"] for v in desc["members"].values()]
+        assert abs(sum(fracs) - 1.0) < 0.01
+        assert all(f > 0.05 for f in fracs)  # 64 vnodes: no starved member
+
+    def test_affinity_key_stable_as_history_grows(self):
+        """A session's key is its FIRST full page's digest: appending
+        turns never changes it, so the pin survives history growth."""
+        history = tokens(PAGE * 2, seed=3)
+        k0 = affinity_key_of(history, PAGE)
+        grown = np.concatenate([history, tokens(PAGE * 3, seed=4)])
+        assert affinity_key_of(grown, PAGE) == k0
+        # a different first page is a different key
+        assert affinity_key_of(tokens(PAGE * 2, seed=9), PAGE) != k0
+
+    def test_subpage_prompt_hashes_whole(self):
+        short = tokens(PAGE - 2, seed=5)
+        assert affinity_key_of(short, PAGE) == affinity_key_of(short, PAGE)
+        assert affinity_key_of(short, PAGE) != affinity_key_of(
+            tokens(PAGE - 2, seed=6), PAGE
+        )
+
+
+# -- prefix-affinity routing in the RouteTable -------------------------------
+
+
+class TestAffinityRouting:
+    def make_table(self, keys, depths=None):
+        t = RouteTable(affinity=True, metrics=Metrics())
+        for i, k in enumerate(keys):
+            t.observe(k, 0.0 if depths is None else depths[i])
+        return t
+
+    def test_affine_owner_beats_least_depth_within_spill(self):
+        keys = [f"default/p-{i}" for i in range(3)]
+        t = self.make_table(keys)
+        ring = AffinityRing()
+        for k in keys:
+            ring.add(k)
+        akey = affinity_key_of(tokens(PAGE, seed=1), PAGE)
+        owner = ring.owner(akey)
+        # load the owner a LITTLE (inside the spill threshold): the warm
+        # cache still wins over the idle replicas
+        t.release(t.pick())  # touch to keep entries fresh
+        t.observe(owner, AFFINITY_SPILL_DEPTH - 1.0)
+        for _ in range(3):
+            got = t.pick(affinity_key=akey)
+            assert got == owner
+            t.release(got)
+
+    def test_spills_to_least_depth_past_threshold(self):
+        keys = [f"default/p-{i}" for i in range(3)]
+        t = self.make_table(keys)
+        ring = AffinityRing()
+        for k in keys:
+            ring.add(k)
+        akey = affinity_key_of(tokens(PAGE, seed=2), PAGE)
+        owner = ring.owner(akey)
+        # bury the owner WAY past the spill gap: a cache hit is worth a
+        # bounded wait, never queueing behind a hot key
+        for _ in range(40):
+            t.observe(owner, 40.0)
+        got = t.pick(affinity_key=akey)
+        assert got != owner
+        t.release(got)
+
+    def test_removed_owner_keys_move_to_successor_only(self):
+        keys = [f"default/p-{i}" for i in range(4)]
+        t = self.make_table(keys)
+        akeys = [f"sess-{i}" for i in range(60)]
+        before = {}
+        for a in akeys:
+            got = t.pick(affinity_key=a)
+            before[a] = got
+            t.release(got)
+        victims = {k for k in keys if k == before[akeys[0]]}
+        victim = victims.pop()
+        t.remove(victim)
+        ring = AffinityRing()
+        for k in keys:
+            ring.add(k)
+        for a in akeys:
+            got = t.pick(affinity_key=a)
+            t.release(got)
+            if before[a] == victim:
+                # the victim's keys land on its ring successor
+                succ = [c for c in ring.candidates(a) if c != victim][0]
+                assert got == succ
+            else:
+                assert got == before[a], f"{a} moved off a survivor"
+
+
+# -- the handoff buffer (pure wire form) -------------------------------------
+
+
+class TestHandoffBuffer:
+    def make_buf(self, n=PAGE * 2):
+        toks = [int(t) for t in tokens(n, seed=7)]
+        from tfk8s_tpu.runtime.paging import prefix_digest_chain
+
+        n_pages = -(-n // PAGE)
+        return KVHandoffBuffer(
+            version="seed:0", page_size=PAGE, tokens=toks, last_token=3,
+            gen_budget=4,
+            digests=prefix_digest_chain(toks, PAGE, n // PAGE),
+            kv=[np.arange(n_pages * PAGE * 2 * 4, dtype=np.float32)
+                .reshape(n_pages * PAGE, 2, 4)],
+        )
+
+    def test_wire_roundtrip_preserves_everything(self):
+        buf = self.make_buf()
+        out, nbytes = LocalKVTransport().transfer(buf)
+        assert nbytes == len(buf.to_bytes())
+        assert out.tokens == buf.tokens
+        assert out.last_token == buf.last_token
+        assert out.gen_budget == buf.gen_budget
+        assert out.digests == buf.digests
+        np.testing.assert_array_equal(out.kv[0], buf.kv[0])
+
+    def test_tampered_tokens_refused(self):
+        buf = self.make_buf()
+        buf.tokens[0] = (buf.tokens[0] % 63) + 1 if buf.tokens[0] != 1 else 2
+        with pytest.raises(HandoffError, match="digest chain"):
+            buf.verify()
+
+    def test_truncated_wire_refused(self):
+        wire = self.make_buf().to_bytes()
+        with pytest.raises(HandoffError, match="truncated"):
+            KVHandoffBuffer.from_bytes(wire[:-8])
+
+    def test_bad_magic_refused(self):
+        with pytest.raises(HandoffError, match="magic"):
+            KVHandoffBuffer.from_bytes(b"NOTKVBUF" + b"\x00" * 32)
+
+    def test_wrong_leaf_rows_refused(self):
+        buf = self.make_buf()
+        buf.kv[0] = buf.kv[0][:PAGE]  # one page short
+        with pytest.raises(HandoffError, match="prompt rows"):
+            buf.verify()
+
+
+# -- real decode loops: bit identity across the pool seam --------------------
+
+
+def _make_exec():
+    dec = PagedGptDecoder(
+        "seed:0", slots=4, page_size=PAGE, max_pages=64, gen_tokens=8,
+        size="tiny", prefill_chunk=16,
+    )
+    dec.load()
+    return DecodeLoopExecutor(dec, queue_limit=32, metrics=Metrics()).start()
+
+
+@pytest.fixture(scope="module")
+def pools():
+    """Two prefill executors + one decode executor, each over its own
+    tiny seed:0 decoder (identical params — the handoff contract)."""
+    execs = {"p-a": _make_exec(), "p-b": _make_exec(), "d-x": _make_exec()}
+    yield execs
+    for ex in execs.values():
+        ex.drain(10)
+
+
+class TestHandoffBitIdentity:
+    @pytest.mark.parametrize("plen,gen", [
+        (5, 4),        # sub-page prompt: no full pages ride the chain
+        (PAGE * 2, 6),  # exact page multiple
+        (PAGE * 3 + 3, 8),  # multi-page + trailing partial page
+    ])
+    def test_handoff_generation_bit_identical(self, pools, plen, gen):
+        """ACCEPTANCE PIN: prefill on one replica + KV page handoff +
+        decode on another == single-replica generation, token for
+        token."""
+        prompt = tokens(plen, seed=100 + plen)
+        payload = {"tokens": prompt, "gen_tokens": gen}
+        want = pools["d-x"].submit(payload, timeout=30)["tokens"]
+        pre = pools["p-a"].submit_prefill(payload, timeout=30)
+        buf = pre["handoff"]
+        assert pre["tokens"] == want[:1]  # prefill picked the first token
+        assert buf.n_pages == -(-plen // PAGE)
+        moved, nbytes = LocalKVTransport().transfer(buf)
+        assert nbytes > 0
+        got = pools["d-x"].submit_handoff(moved, timeout=30)["tokens"]
+        assert got == want, (
+            f"handoff continuation diverged at plen={plen}: {got} != {want}"
+        )
+
+    def test_page_size_mismatch_refused(self, pools):
+        buf = pools["p-a"].submit_prefill(
+            {"tokens": tokens(PAGE, seed=41), "gen_tokens": 2}, timeout=30
+        )["handoff"]
+        buf.page_size = PAGE * 2
+        with pytest.raises(HandoffError):
+            pools["d-x"].submit_handoff(buf, timeout=30)
+
+    def test_version_mismatch_refused(self, pools):
+        buf = pools["p-a"].submit_prefill(
+            {"tokens": tokens(PAGE, seed=42), "gen_tokens": 2}, timeout=30
+        )["handoff"]
+        buf.version = "seed:1"
+        with pytest.raises(HandoffError, match="params differ"):
+            pools["d-x"].submit_handoff(buf, timeout=30)
+
+    def test_prefix_cache_counters_in_debug_state(self, pools):
+        """Satellite: /debug/decode surfaces hit/miss counters and the
+        ratio, so the affinity win is observable per replica."""
+        ex = pools["p-b"]
+        prompt = tokens(PAGE * 2, seed=77)
+        ex.submit_prefill({"tokens": prompt, "gen_tokens": 2}, timeout=30)
+        before = ex.debug_state()["prefix_cache"]
+        grown = np.concatenate([prompt, tokens(PAGE, seed=78)])
+        ex.submit_prefill({"tokens": grown, "gen_tokens": 2}, timeout=30)
+        after = ex.debug_state()["prefix_cache"]
+        assert after["hits"] == before["hits"] + 1
+        assert after["misses"] == before["misses"]
+        assert 0.0 <= after["hit_ratio"] <= 1.0
+
+
+# -- the gateway's two-phase dispatch ----------------------------------------
+
+
+@pytest.fixture
+def gw():
+    cs = FakeClientset()
+    metrics = Metrics()
+    server = GatewayServer(cs, port=0, metrics=metrics)
+    server.serve_background()
+    yield cs, server, metrics
+    server.shutdown()
+    server.server_close()
+
+
+def make_disagg_state(cs, server, name, prefill_keys, decode_keys):
+    """A disaggregated gpt TPUServe whose phase tables are seeded
+    directly (no kubelet): prefill and decode replicas are whatever
+    ``lookup_replica`` resolves the keys to."""
+    cs.tpuserves().create(TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task="gpt", checkpoint="seed:0",
+            batching=BatchingPolicy(
+                max_batch_size=4, batch_timeout_ms=2.0, queue_limit=64,
+                page_size=PAGE, max_pages=64,
+            ),
+            disaggregation=DisaggregationPolicy(
+                prefill_replicas=len(prefill_keys),
+                decode_replicas=len(decode_keys),
+            ),
+        ),
+    ))
+    state = server.state_for("default", name)
+    assert state.disagg
+    for i, key in enumerate(prefill_keys):
+        state.prefill.observe(key, float(i) * 0.01)
+    for i, key in enumerate(decode_keys):
+        state.decode.observe(key, float(i) * 0.01)
+    return state
+
+
+class TestDisaggGateway:
+    def test_two_phase_roundtrip_is_bit_identical_and_sets_session(
+        self, gw, pools, monkeypatch
+    ):
+        cs, server, metrics = gw
+        monkeypatch.setattr(gw_mod, "lookup_replica", {
+            "default/p-a": pools["p-a"], "default/d-x": pools["d-x"],
+        }.get)
+        make_disagg_state(cs, server, "dz", ["default/p-a"], ["default/d-x"])
+        prompt = tokens(PAGE * 2, seed=200)
+        payload = {"tokens": [int(t) for t in prompt], "gen_tokens": 4}
+        want = pools["d-x"].submit(payload, timeout=30)["tokens"]
+        meta = {}
+        out = server.dispatch("default", "dz", "default", payload, 20.0,
+                              meta=meta)
+        assert out["tokens"] == want
+        assert meta["session"] == affinity_key_of(prompt, PAGE)
+        assert metrics.get_counter("tfk8s_disagg_handoffs_total", {
+            "serve": "default/dz", "outcome": "ok",
+        }) >= 1
+
+    def test_session_repins_after_affine_replica_ejection(
+        self, gw, pools, monkeypatch
+    ):
+        """Satellite: a multi-turn session whose affine prefill replica
+        is ejected re-prefills its history EXACTLY once on the ring
+        successor, then re-pins — turn N+2 hits the successor's now-warm
+        cache."""
+        cs, server, _ = gw
+        keys = ["default/p-a", "default/p-b"]
+        monkeypatch.setattr(gw_mod, "lookup_replica", {
+            "default/p-a": pools["p-a"], "default/p-b": pools["p-b"],
+            "default/d-x": pools["d-x"],
+        }.get)
+        state = make_disagg_state(cs, server, "sess", keys, ["default/d-x"])
+
+        history = tokens(PAGE * 2, seed=300)
+        meta = {}
+        state.prefill.observe(keys[0], 0.0)
+        state.prefill.observe(keys[1], 0.0)
+        out = server.dispatch(
+            "default", "sess", "default",
+            {"tokens": [int(t) for t in history], "gen_tokens": 4},
+            20.0, meta=meta,
+        )
+        akey = meta["session"]
+        ring = AffinityRing()
+        for k in keys:
+            ring.add(k)
+        owner = ring.owner(akey)
+        survivor = keys[0] if owner == keys[1] else keys[1]
+        by_key = {"default/p-a": pools["p-a"], "default/p-b": pools["p-b"]}
+        owner_ex, surv_ex = by_key[owner], by_key[survivor]
+
+        def counters(ex):
+            pc = ex.debug_state()["prefix_cache"]
+            return pc["hits"], pc["misses"]
+
+        def turn(hist, sess):
+            # keep both tables fresh across the slow first compile-free
+            # submits (entries go stale after 3s of silence)
+            for k in keys:
+                if k != ejected.get("key"):
+                    state.prefill.observe(k, 0.0)
+            state.decode.observe("default/d-x", 0.0)
+            meta = {}
+            out = server.dispatch(
+                "default", "sess", "default",
+                {"tokens": [int(t) for t in hist], "gen_tokens": 4},
+                20.0, session=sess, meta=meta,
+            )
+            assert meta["session"] == sess
+            return np.concatenate(
+                [hist, np.asarray(out["tokens"], np.int32),
+                 tokens(4, seed=len(hist))]
+            )
+
+        ejected = {}
+        h0, m0 = counters(owner_ex)
+        history = turn(history, akey)  # turn 2: hits the owner's cache
+        h1, m1 = counters(owner_ex)
+        assert (h1, m1) == (h0 + 1, m0), "turn 2 must hit the affine cache"
+
+        # eject the affine owner: its keys rebalance to the successor
+        ejected["key"] = owner
+        state.prefill.remove(owner)
+        sh0, sm0 = counters(surv_ex)
+        history = turn(history, akey)  # turn 3: ONE re-prefill
+        sh1, sm1 = counters(surv_ex)
+        assert (sh1, sm1) == (sh0, sm0 + 1), (
+            "the survivor must re-prefill the history exactly once"
+        )
+        turn(history, akey)  # turn 4: re-pinned, warm again
+        sh2, sm2 = counters(surv_ex)
+        assert (sh2, sm2) == (sh1 + 1, sm1), (
+            "turn 4 must hit the successor's now-warm cache (re-pinned)"
+        )
+
+    def test_decode_death_mid_handoff_reroutes_without_reprefill(
+        self, gw, pools, monkeypatch
+    ):
+        """The failure-matrix row: the handoff target dies mid-transfer.
+        The gateway still HOLDS the buffer, so a surviving decode replica
+        takes the SAME handoff — the prefill work is never repeated."""
+        cs, server, metrics = gw
+
+        class _DeadDecode:
+            calls = 0
+
+            def submit_handoff(self, buf, **kw):
+                self.calls += 1
+                raise ReplicaUnavailable("chaos: decode host died")
+
+        dead = _DeadDecode()
+        monkeypatch.setattr(gw_mod, "lookup_replica", {
+            "default/p-a": pools["p-a"], "default/d-dead": dead,
+            "default/d-x": pools["d-x"],
+        }.get)
+        state = make_disagg_state(
+            cs, server, "hdie", ["default/p-a"],
+            ["default/d-dead", "default/d-x"],
+        )
+        # the dead replica is the least-loaded pick; the live one is deeper
+        state.decode.observe("default/d-dead", 0.0)
+        for _ in range(4):
+            state.decode.observe("default/d-x", 2.0)
+        served_before = pools["p-a"].served_total
+        prompt = tokens(PAGE * 2, seed=400)
+        payload = {"tokens": [int(t) for t in prompt], "gen_tokens": 4}
+        want = pools["d-x"].submit(payload, timeout=30)["tokens"]
+        out = server.dispatch("default", "hdie", "default", payload, 20.0)
+        assert out["tokens"] == want
+        assert dead.calls == 1
+        # ONE prefill happened — the retry reused the gateway-held buffer
+        assert pools["p-a"].served_total == served_before + 1
+        assert metrics.get_counter("tfk8s_gateway_retries_total", {
+            "serve": "default/hdie", "tenant": "default",
+            "reason": "transport",
+        }) == 1.0
+
+    def test_debug_routes_shows_phase_tables_and_ring(self, gw, pools,
+                                                      monkeypatch):
+        """Satellite: /debug/routes renders per-phase replica rows plus
+        the affinity ring's ownership map."""
+        import http.client
+
+        cs, server, _ = gw
+        monkeypatch.setattr(gw_mod, "lookup_replica", {
+            "default/p-a": pools["p-a"], "default/d-x": pools["d-x"],
+        }.get)
+        make_disagg_state(cs, server, "dbg", ["default/p-a"],
+                          ["default/d-x"])
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/debug/routes")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        finally:
+            conn.close()
+        entry = body["serves"]["default/dbg"]
+        assert set(entry) == {"prefill", "decode"}
+        assert entry["prefill"]["replicas"][0]["replica"] == "default/p-a"
+        ring = entry["prefill"]["ring"]
+        assert "default/p-a" in ring["members"]
+        assert "ring" not in entry["decode"]  # depth-only pool: no ring
+
+
+# -- API + controller rendering ----------------------------------------------
+
+
+def make_disagg_serve(name="dg", task="gpt", prefill=2, decode=3):
+    return TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task=task, checkpoint="seed:0",
+            batching=BatchingPolicy(page_size=PAGE, max_pages=64),
+            disaggregation=DisaggregationPolicy(
+                prefill_replicas=prefill, decode_replicas=decode,
+            ),
+        ),
+    )
+
+
+class TestDisaggAPI:
+    def test_non_generative_task_refused(self):
+        errs = validate_serve(set_serve_defaults(
+            make_disagg_serve(task="echo")
+        ))
+        assert any("generative" in e for e in errs)
+
+    def test_pool_counts_must_be_positive(self):
+        errs = validate_serve(set_serve_defaults(
+            make_disagg_serve(prefill=0, decode=-1)
+        ))
+        assert any("prefillReplicas" in e for e in errs)
+        assert any("decodeReplicas" in e for e in errs)
+
+    def test_valid_disagg_spec_passes(self):
+        assert validate_serve(set_serve_defaults(make_disagg_serve())) == []
+
+    def test_autoscale_clamps_pool_counts(self):
+        serve = make_disagg_serve(prefill=9, decode=0)
+        serve.spec.autoscale = AutoscalePolicy(
+            enabled=True, min_replicas=1, max_replicas=4
+        )
+        set_serve_defaults(serve)
+        assert serve.spec.disaggregation.prefill_replicas == 4
+        assert serve.spec.disaggregation.decode_replicas == 1
+
+    def test_serde_roundtrip(self):
+        from tfk8s_tpu.api import serde
+
+        serve = make_disagg_serve()
+        wire = serde.to_wire(serve)
+        assert wire["spec"]["disaggregation"] == {
+            "prefillReplicas": 2, "decodeReplicas": 3,
+        }
+        back = serde.from_dict(TPUServe, json.loads(json.dumps(wire)))
+        assert back.spec.disaggregation == serve.spec.disaggregation
+
+
+class TestDisaggControllerRender:
+    def test_serve_pools_split(self):
+        single = TPUServe(spec=TPUServeSpec(task="echo", replicas=3))
+        assert serve_pools(single) == [("", 3)]
+        assert serve_pools(make_disagg_serve(prefill=2, decode=3)) == [
+            ("prefill", 2), ("decode", 3),
+        ]
+
+    def test_phase_pod_carries_name_env_and_label(self):
+        serve = make_disagg_serve(name="dgp")
+        version = _serve_version(serve)
+        pod = render_serve_pod(serve, version, 0, phase="prefill")
+        assert pod.metadata.name == f"dgp-srv-{version}-prefill-0"
+        assert pod.metadata.labels[L.SERVE_PHASE] == "prefill"
+        env = pod.spec.containers[0].env
+        assert env["TFK8S_SERVE_PHASE"] == "prefill"
+        # pool-local indices coexist: decode-0 is a different pod name
+        other = render_serve_pod(serve, version, 0, phase="decode")
+        assert other.metadata.name != pod.metadata.name
+
+    def test_single_pool_pod_has_no_phase(self):
+        serve = make_disagg_serve(name="sp")
+        serve.spec.disaggregation = None
+        pod = render_serve_pod(serve, _serve_version(serve), 1)
+        assert L.SERVE_PHASE not in pod.metadata.labels
+        assert "TFK8S_SERVE_PHASE" not in pod.spec.containers[0].env
+
+    def test_version_rolls_on_presence_not_counts(self):
+        """Pool COUNTS scale in place (like spec.replicas); adding or
+        removing the disaggregation block itself rolls the template."""
+        base = make_disagg_serve()
+        v1 = _serve_version(base)
+        resized = make_disagg_serve(prefill=4, decode=1)
+        assert _serve_version(resized) == v1
+        single = make_disagg_serve()
+        single.spec.disaggregation = None
+        assert _serve_version(single) != v1
+
+
+# -- full cluster e2e (slow: two real gpt replicas through the kubelet) ------
+
+
+@pytest.mark.slow
+class TestDisaggE2E:
+    def test_disagg_serve_e2e_with_sticky_session(self, monkeypatch):
+        import tfk8s_tpu.runtime.kubelet as kubelet_mod
+        import tfk8s_tpu.trainer.serve_controller as sc_mod
+        from tfk8s_tpu.gateway.client import GatewayClient
+        from tfk8s_tpu.runtime import LocalKubelet
+        from tfk8s_tpu.trainer import TPUServeController
+
+        from conftest import wait_for
+
+        monkeypatch.setattr(kubelet_mod, "LOG_FLUSH_SECONDS", 0.05)
+        monkeypatch.setattr(sc_mod, "AUTOSCALE_PERIOD_S", 0.1)
+        cs = FakeClientset()
+        ctrl = TPUServeController(cs)
+        kubelet = LocalKubelet(cs)
+        stop = threading.Event()
+        kubelet.run(stop)
+        assert ctrl.run(workers=2, stop=stop, block=False)
+        metrics = Metrics()
+        gw = GatewayServer(cs, port=0, metrics=metrics)
+        gw.serve_background()
+        try:
+            serve = make_disagg_serve(name="dge2e", prefill=1, decode=1)
+            serve.spec.batching.max_batch_size = 4
+            serve.spec.batching.batch_timeout_ms = 2.0
+            serve.spec.batching.queue_limit = 64
+            serve.spec.template.env["TFK8S_SERVE_GEN_TOKENS"] = "4"
+            serve.spec.template.env["TFK8S_SERVE_GPT_SIZE"] = "tiny"
+            cs.tpuserves().create(serve)
+
+            def ready():
+                try:
+                    return cs.tpuserves().get("dge2e").status.ready_replicas
+                except Exception:  # noqa: BLE001
+                    return -1
+
+            assert wait_for(lambda: ready() == 2, timeout=120)
+            # one pod per phase, each labeled and env-tagged
+            pods, _ = cs.pods().list(
+                label_selector=L.serve_selector("dge2e")
+            )
+            phases = sorted(
+                p.metadata.labels.get(L.SERVE_PHASE, "") for p in pods
+            )
+            assert phases == ["decode", "prefill"]
+            # status advertises BOTH phase endpoints
+            endpoint = cs.tpuserves().get("dge2e").status.endpoint
+            assert endpoint == (
+                "/v1/serve/default/dge2e#prefill,/v1/serve/default/dge2e#decode"
+            )
+
+            client = GatewayClient(gw.url, "dge2e")
+            history = [int(t) for t in tokens(PAGE * 2, seed=500)]
+            out = client.request(
+                {"tokens": history, "gen_tokens": 4}, timeout=60
+            )
+            assert len(out["tokens"]) == 4
+            assert client.session, "disagg gateway must return the session"
+            # the follow-up turn rides the sticky session
+            history += out["tokens"] + [int(t) for t in tokens(4, seed=501)]
+            out2 = client.request(
+                {"tokens": history, "gen_tokens": 4}, timeout=60
+            )
+            assert len(out2["tokens"]) == 4
+            assert metrics.get_counter("tfk8s_disagg_handoffs_total", {
+                "serve": "default/dge2e", "outcome": "ok",
+            }) >= 2
+            client.close()
+
+            # /debug/routes shows the prefill ring over the live pod
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", gw.port,
+                                              timeout=10)
+            try:
+                conn.request("GET", "/debug/routes")
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+            finally:
+                conn.close()
+            entry = body["serves"]["default/dge2e"]
+            assert len(entry["prefill"]["ring"]["members"]) == 1
+        finally:
+            stop.set()
+            gw.shutdown()
+            gw.server_close()
+            ctrl.controller.shutdown()

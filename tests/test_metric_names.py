@@ -78,6 +78,16 @@ def test_default_scope_covers_hotpath_counters():
         "tfk8s_gateway_retries_total": False,
         "tfk8s_gateway_replica_removed_total": False,
         "tfk8s_serving_rows_quarantined_total": False,
+        # ISSUE-14 disaggregation series: the disagg bench arm and the
+        # handoff/affinity tests key off these exact names
+        "tfk8s_serving_prefix_cache_misses_total": False,
+        "tfk8s_disagg_exports_total": False,
+        "tfk8s_disagg_imports_total": False,
+        "tfk8s_disagg_handoffs_total": False,
+        "tfk8s_disagg_handoff_seconds": False,
+        "tfk8s_disagg_handoff_bytes": False,
+        "tfk8s_gateway_affinity_requests_total": False,
+        "tfk8s_gateway_affinity_ring_members": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

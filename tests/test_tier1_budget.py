@@ -70,6 +70,11 @@ HEAVY = [
     # below gates the same recovery machinery in tier-1
     ("test_chaos_serving.py",
      "TestMultiShapeSweep.test_seeded_sweep_keeps_every_failure_typed"),
+    # ISSUE 14: the full-cluster disaggregated e2e loads two gpt
+    # replicas through the kubelet — the component-level gateway tests
+    # in the same module gate the handoff/affinity machinery fast
+    ("test_disagg_serving.py",
+     "TestDisaggE2E.test_disagg_serve_e2e_with_sticky_session"),
 ]
 
 # The fast representative that keeps each subsystem gated in tier-1.
@@ -102,6 +107,10 @@ FAST_GATES = [
      "TestSingleRowIsolation.test_poisoned_row_retires_typed_siblings_bit_identical"),
     ("test_chaos_serving.py",
      "TestSingleKill.test_replica_crash_costs_zero_failed_requests"),
+    # ISSUE 14 disaggregated serving: the two-phase dispatch with a
+    # bit-identical KV handoff must stay gated in tier-1
+    ("test_disagg_serving.py",
+     "TestDisaggGateway.test_two_phase_roundtrip_is_bit_identical_and_sets_session"),
 ]
 
 

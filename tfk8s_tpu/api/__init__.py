@@ -10,6 +10,7 @@ from tfk8s_tpu.api.types import (  # noqa: F401
     CleanPodPolicy,
     Condition,
     ContainerSpec,
+    DisaggregationPolicy,
     ElasticPolicy,
     JobConditionType,
     MeshSpec,

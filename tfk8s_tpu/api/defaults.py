@@ -101,6 +101,16 @@ def set_serve_defaults(serve: TPUServe) -> TPUServe:
         # the autoscaler owns replicas between its bounds; a spec count
         # outside them is clamped rather than rejected (HPA semantics)
         spec.replicas = min(max(spec.replicas, auto.min_replicas), auto.max_replicas)
+        if spec.disaggregation is not None:
+            # each phase pool is autoscaled independently against the
+            # same bounds (per-pool signals, trainer/serve_controller)
+            d = spec.disaggregation
+            d.prefill_replicas = min(
+                max(d.prefill_replicas, auto.min_replicas), auto.max_replicas
+            )
+            d.decode_replicas = min(
+                max(d.decode_replicas, auto.min_replicas), auto.max_replicas
+            )
     ten = spec.tenancy
     if ten.enabled:
         # burst=0 means "one second's worth of tokens, at least 1" — the

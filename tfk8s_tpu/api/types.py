@@ -388,6 +388,23 @@ class AutoscalePolicy:
 
 
 @dataclass
+class DisaggregationPolicy:
+    """Splitwise/DistServe-style phase disaggregation: the serve runs
+    TWO labeled replica pools — prefill (compute-bound, bursty) and
+    decode (memory-bound, steady) — instead of one. The gateway runs
+    chunked prefill on a prefill replica, moves the warm KV across the
+    pool seam (runtime/handoff.py), and admits the row directly into a
+    decode replica's loop; a prompt burst then queues on the prefill
+    pool instead of stalling in-flight generations. Present in the spec
+    ⇒ pool counts REPLACE ``spec.replicas`` and each pool autoscales
+    off its own signal (prefill queue depth vs decode slot occupancy);
+    absent ⇒ single-pool serving, bit-for-bit today's behavior."""
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+
+
+@dataclass
 class TenantQuota:
     """One tenant's admission budget at the gateway (gateway/admission.py).
     ``qps``/``burst`` parameterize a reservation-style token bucket
@@ -440,6 +457,10 @@ class TPUServeSpec:
     # gateway admission only — excluded from the pod-template hash
     tenancy: TenantPolicy = field(default_factory=TenantPolicy)
     tpu: TPUSpec = field(default_factory=TPUSpec)
+    # phase-split pools (None = single-pool serving, today's behavior);
+    # changing pool COUNTS scales in place, but adding/removing the
+    # block itself rolls the template (the pods' phase env changes)
+    disaggregation: Optional[DisaggregationPolicy] = None
 
 
 @dataclass

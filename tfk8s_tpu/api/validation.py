@@ -254,6 +254,27 @@ def validate_serve(serve: TPUServe) -> List[str]:
         if a.cooldown_s < 0:
             errs.append(f"spec.autoscale.cooldownS: must be >= 0, got {a.cooldown_s}")
 
+    d = spec.disaggregation
+    if d is not None:
+        if spec.task not in ("gpt", "t5"):
+            # phase splitting only means something for generative tasks:
+            # the handoff plane moves KV pages, which classifiers and
+            # echo replicas don't have
+            errs.append(
+                f"spec.disaggregation: only generative tasks (gpt, t5) "
+                f"can split prefill/decode pools, got task {spec.task!r}"
+            )
+        if d.prefill_replicas < 1:
+            errs.append(
+                f"spec.disaggregation.prefillReplicas: must be >= 1, "
+                f"got {d.prefill_replicas}"
+            )
+        if d.decode_replicas < 1:
+            errs.append(
+                f"spec.disaggregation.decodeReplicas: must be >= 1, "
+                f"got {d.decode_replicas}"
+            )
+
     ten = spec.tenancy
     if ten.enabled:
         for path, quota in [

@@ -114,6 +114,12 @@ class GatewayClient:
             "Content-Type: application/json\r\n"
             + (f"X-Tenant: {tenant}\r\n" if tenant else "")
         ).encode("ascii")
+        # the sticky routing token a disaggregated gateway returned on
+        # the last 200 (``x-tfk8s-session``): echoed on every later
+        # request so follow-up turns stay affine to the replica holding
+        # the conversation's warm KV prefix. Single-pool gateways never
+        # set it; ``reset_session()`` starts a fresh conversation.
+        self.session: Optional[str] = None
         # one warm connection per thread: sockets are not safely shared
         # mid-request, and per-thread reuse is what keeps the wire path
         # pipelined under a threaded load generator
@@ -148,6 +154,10 @@ class GatewayClient:
     def close(self) -> None:
         self._drop_conn()
 
+    def reset_session(self) -> None:
+        """Forget the sticky routing token (a new conversation)."""
+        self.session = None
+
     # -- wire ----------------------------------------------------------------
 
     def _roundtrip(self, body: bytes,
@@ -163,6 +173,9 @@ class GatewayClient:
             f"traceparent: {traceparent}\r\n".encode("ascii")
             if traceparent else b""
         )
+        session = self.session
+        if session:
+            tp += f"x-tfk8s-session: {session}\r\n".encode("ascii")
         request = b"%s%sContent-Length: %d\r\n\r\n%s" % (
             self._head, tp, len(body), body
         )
@@ -266,6 +279,9 @@ class GatewayClient:
                             continue
                     raise Unavailable(f"gateway unreachable: {exc}") from exc
                 if status == 200:
+                    sess = headers.get("x-tfk8s-session")
+                    if sess:
+                        self.session = sess
                     span.set_attribute("http.status_code", 200)
                     return json.loads(data)["result"]
                 try:

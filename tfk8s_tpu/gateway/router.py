@@ -46,6 +46,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from tfk8s_tpu.gateway import health as _health
+from tfk8s_tpu.gateway.affinity import AFFINITY_SPILL_DEPTH, AffinityRing
 from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.trainer.serve_controller import EMA_ALPHA
 from tfk8s_tpu.utils.logging import get_logger
@@ -83,10 +84,21 @@ class RouteTable:
         stale_after_s: float = STALE_AFTER_S,
         metrics=None,
         clock=time.monotonic,
+        phase: str = "",
+        affinity: bool = False,
     ):
         self._cs = clientset
         self.name = name
         self.namespace = namespace
+        # disaggregated serves run one table per phase pool; discovery
+        # then selects on the pool's phase label so prefill traffic can
+        # never land on a decode replica (and vice versa)
+        self.phase = phase
+        # prefix-affinity: membership mirrors the entry set (added on
+        # first observe, dropped with every removal), so ring state needs
+        # no separate lifecycle. Guarded by self._lock like everything
+        # else — AffinityRing itself is not thread-safe.
+        self._ring: Optional[AffinityRing] = AffinityRing() if affinity else None
         self._cache_ttl = cache_ttl_s
         self._stale_after = stale_after_s
         self._metrics = metrics
@@ -114,6 +126,8 @@ class RouteTable:
             e = self._entries.get(key)
             if e is None:
                 self._entries[key] = _Entry(float(depth), now)
+                if self._ring is not None:
+                    self._ring.add(key)
             else:
                 e.depth = EMA_ALPHA * float(depth) + (1 - EMA_ALPHA) * e.depth
                 e.seen = now
@@ -152,9 +166,11 @@ class RouteTable:
         from tfk8s_tpu.runtime.server import replica_is_ready
         from tfk8s_tpu.trainer import labels as L
 
-        pods, _rv = self._cs.pods(self.namespace).list(
-            label_selector=L.serve_selector(self.name)
+        selector = (
+            L.serve_phase_selector(self.name, self.phase)
+            if self.phase else L.serve_selector(self.name)
         )
+        pods, _rv = self._cs.pods(self.namespace).list(label_selector=selector)
         for p in pods:
             if replica_is_ready(p):
                 self.observe(
@@ -165,32 +181,66 @@ class RouteTable:
 
     # -- routing -------------------------------------------------------------
 
-    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
+    def pick(
+        self,
+        exclude: Optional[Set[str]] = None,
+        affinity_key: Optional[str] = None,
+    ) -> Optional[str]:
         """Least effective depth (published EMA + local in-flight +
         Suspect penalty) among fresh, non-draining, non-excluded,
         ROUTABLE replicas; leases an in-flight slot on the winner. An
         Ejected replica is routable only as a half-open probe (cooldown
         elapsed, probe circuit open) — the pick leases its probe slot.
-        None when nothing is routable."""
+        None when nothing is routable.
+
+        With ``affinity_key`` (and the ring enabled), the consistent-hash
+        owner of the key wins INSTEAD of the least-loaded replica —
+        unless the owner is non-routable (ejected/draining replicas fall
+        off the ring walk and their keys rebalance to the successor) or
+        more than ``AFFINITY_SPILL_DEPTH`` effective requests deeper than
+        the fleet minimum, in which case the request spills to the
+        least-depth pick (warm KV is worth a bounded queue, not an
+        unbounded one)."""
         self.refresh()
         now = self._clock()
         probe = False
+        route: Optional[str] = None
         with self._lock:
             self._purge_locked(now)
+
+            def eff(key: str) -> float:
+                e = self._entries[key]
+                return (
+                    e.depth + self._inflight.get(key, 0)
+                    + e.health.depth_penalty()
+                )
+
             best: Optional[str] = None
             best_depth = 0.0
             for key in sorted(self._entries):  # sorted: deterministic ties
                 if exclude and key in exclude:
                     continue
-                e = self._entries[key]
-                if not e.health.routable(now):
+                if not self._entries[key].health.routable(now):
                     continue
-                d = (
-                    e.depth + self._inflight.get(key, 0)
-                    + e.health.depth_penalty()
-                )
+                d = eff(key)
                 if best is None or d < best_depth:
                     best, best_depth = key, d
+            if self._ring is not None:
+                route = "none"
+                if affinity_key:
+                    route = "spill"
+                    for cand in self._ring.candidates(affinity_key):
+                        if exclude and cand in exclude:
+                            continue
+                        e = self._entries.get(cand)
+                        if e is None or not e.health.routable(now):
+                            continue
+                        d = eff(cand)
+                        if best is None or d <= best_depth + AFFINITY_SPILL_DEPTH:
+                            best, best_depth = cand, d
+                            route = "affine"
+                        # first ROUTABLE successor decides: pin or spill
+                        break
             if best is not None:
                 h = self._entries[best].health
                 if h.state == _health.EJECTED:
@@ -199,11 +249,18 @@ class RouteTable:
                 self._inflight[best] = self._inflight.get(best, 0) + 1
                 self._last_pick[best] = now
         if best is not None:
+            if route is not None and self._metrics is not None:
+                self._metrics.inc(
+                    "tfk8s_gateway_affinity_requests_total", 1.0,
+                    {"serve": f"{self.namespace}/{self.name}", "route": route,
+                     **({"phase": self.phase} if self.phase else {})},
+                )
             span = get_tracer().current_span()
             if span is not None:
                 span.add_event("route.pick", {
                     "replica": best, "effective_depth": best_depth,
                     **({"probe": True} if probe else {}),
+                    **({"route": route} if route is not None else {}),
                 })
         return best
 
@@ -317,6 +374,34 @@ class RouteTable:
             e = self._entries.get(key)
             return e.health.state if e is not None else None
 
+    def debug_rows(self) -> List[dict]:
+        """Full per-replica table dump for ``/debug/routes`` — unlike
+        ``targets`` this includes Ejected entries (the interesting ones
+        when debugging routing), with health state and in-flight count."""
+        now = self._clock()
+        with self._lock:
+            self._purge_locked(now)
+            return [
+                {
+                    "replica": k,
+                    "health": e.health.state,
+                    "effective_depth": round(
+                        e.depth + self._inflight.get(k, 0)
+                        + e.health.depth_penalty(), 3
+                    ),
+                    "inflight": self._inflight.get(k, 0),
+                }
+                for k, e in sorted(self._entries.items())
+            ]
+
+    def ring_describe(self) -> Optional[dict]:
+        """The affinity ring's ownership map (None when affinity is
+        off) — the ``/debug/routes`` companion to ``debug_rows``."""
+        if self._ring is None:
+            return None
+        with self._lock:
+            return self._ring.describe()
+
     def last_pick_s(self, key: str) -> Optional[float]:
         """Clock stamp of the LAST pick of ``key`` (kept past removal):
         kill-to-last-pick is the chaos bench's ``ejection_time_ms``."""
@@ -347,6 +432,8 @@ class RouteTable:
 
     def _removed_locked(self, key: str, reason: str) -> None:
         self._entries.pop(key, None)
+        if self._ring is not None:
+            self._ring.remove(key)
         if self._metrics is not None:
             self._metrics.inc(
                 "tfk8s_gateway_replica_removed_total", 1.0,
@@ -368,6 +455,8 @@ class RouteTable:
             return
         rows = self.targets()  # takes the lock itself; gauges set outside
         labels = {"serve": f"{self.namespace}/{self.name}"}
+        if self.phase:
+            labels["phase"] = self.phase
         self._metrics.set_gauge(
             "tfk8s_gateway_route_replicas", float(len(rows)), labels
         )
@@ -375,3 +464,9 @@ class RouteTable:
             "tfk8s_gateway_route_depth",
             min((d for _, d in rows), default=0.0), labels,
         )
+        if self._ring is not None:
+            with self._lock:
+                members = len(self._ring)
+            self._metrics.set_gauge(
+                "tfk8s_gateway_affinity_ring_members", float(members), labels
+            )

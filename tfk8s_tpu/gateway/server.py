@@ -45,9 +45,15 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from tfk8s_tpu.client.ratelimit import TokenBucketRateLimiter
 from tfk8s_tpu.client.store import NotFound, Unavailable
 from tfk8s_tpu.gateway.admission import TenantAdmission
+from tfk8s_tpu.gateway.affinity import affinity_key_of
 from tfk8s_tpu.gateway.router import RouteTable
 from tfk8s_tpu.obs.trace import TailSampler, get_tracer, recent_request_traces
 from tfk8s_tpu.runtime import server as serving
+from tfk8s_tpu.runtime.handoff import (
+    HandoffError,
+    KVTransport,
+    LocalKVTransport,
+)
 from tfk8s_tpu.runtime.server import (
     DeadlineExceeded,
     Draining,
@@ -125,6 +131,11 @@ def _wire_error(exc: Exception) -> Tuple[int, str, Dict[str, Any], Dict[str, str
         # transport-class: the replica died mid-flight and the retry
         # budget ran out — retriable by the caller, NOT a model failure
         return 503, "Unavailable", _tried_details(exc), headers
+    if isinstance(exc, HandoffError):
+        # the decode pool refused the prefill pool's KV buffer (version
+        # skew mid-rollout, geometry mismatch, integrity failure): a
+        # between-replicas failure, not the caller's and not the model's
+        return 502, "HandoffFailed", {}, headers
     # Draining should be absorbed by the dispatch loop; RequestFailed and
     # any other ServeError are the model's failure, a plain 500
     return 500, "RequestFailed", {}, headers
@@ -248,11 +259,14 @@ class _Handler(BaseHTTPRequestHandler):
         _Handler._date_cache = (now, value)
         return value
 
-    def _send_json(self, status: int, payload: Any) -> None:
+    def _send_json(self, status: int, payload: Any,
+                   extra_headers: Optional[Dict[str, str]] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -288,6 +302,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/debug/decode":
             self._send_json(200, debug_decode())
             return
+        if path == "/debug/routes":
+            self._send_json(200, self.server.debug_routes())
+            return
         self._send_status_error(404, "NotFound", self.path)
 
     def do_POST(self) -> None:
@@ -320,11 +337,14 @@ class _Handler(BaseHTTPRequestHandler):
             tail_sample=True,
         )
         self.server.track_inflight(span, serve_label, tenant)
+        meta: Dict[str, str] = {}
         try:
             with span:
                 try:
                     result = self.server.dispatch(
-                        namespace, name, tenant, body.get("payload"), timeout
+                        namespace, name, tenant, body.get("payload"), timeout,
+                        session=self.headers.get("x-tfk8s-session"),
+                        meta=meta,
                     )
                 except Exception as exc:  # noqa: BLE001 - typed wire errors
                     err = exc
@@ -357,20 +377,34 @@ class _Handler(BaseHTTPRequestHandler):
                     or getattr(err, "reason", None) or "overloaded",
                 })
         if err is None:
-            self._send_json(200, {"result": result})
+            # disaggregated serves hand the caller its routing pin: echo
+            # the session token on follow-up requests to stay affine to
+            # the replica holding the conversation's warm KV prefix
+            self._send_json(200, {"result": result}, extra_headers=(
+                {"x-tfk8s-session": meta["session"]}
+                if meta.get("session") else None
+            ))
         else:
             self._send_status_error(code, reason, str(err), details, headers)
 
 
 class _ServeState:
     """Per-TPUServe routing + admission, plus the TTL-cached spec bits
-    the hot path needs (queue limit, tenancy)."""
+    the hot path needs (queue limit, tenancy). A disaggregated serve
+    carries TWO route tables — one per phase pool, each discovering only
+    its pool's pods; ``table`` aliases the prefill table there so the
+    admission pressure signal reads the pool requests enter first."""
 
     __slots__ = ("table", "admission", "queue_limit", "fetched",
-                 "retry_budget")
+                 "retry_budget", "prefill", "decode", "page_size")
 
-    def __init__(self, table: RouteTable):
+    def __init__(self, table: RouteTable,
+                 prefill: Optional[RouteTable] = None,
+                 decode: Optional[RouteTable] = None):
         self.table = table
+        self.prefill = prefill
+        self.decode = decode
+        self.page_size = 0
         self.admission = TenantAdmission()
         self.queue_limit = 0
         self.fetched = 0.0
@@ -379,6 +413,15 @@ class _ServeState:
         self.retry_budget = TokenBucketRateLimiter(
             RETRY_BUDGET_QPS, RETRY_BUDGET_BURST
         )
+
+    @property
+    def disagg(self) -> bool:
+        return self.prefill is not None
+
+    def named_tables(self) -> list:
+        if self.prefill is not None:
+            return [("prefill", self.prefill), ("decode", self.decode)]
+        return [("", self.table)]
 
 
 class GatewayServer(ThreadingHTTPServer):
@@ -437,6 +480,29 @@ class GatewayServer(ThreadingHTTPServer):
                 "Replicas removed from the route table, by reason "
                 "(stale/drained/ejected).",
             )
+            metrics.describe(
+                "tfk8s_gateway_affinity_requests_total",
+                "Affinity-routed picks, by route "
+                "(affine=ring owner, spill=owner too deep, none=no key).",
+            )
+            metrics.describe(
+                "tfk8s_gateway_affinity_ring_members",
+                "Replicas on the prefix-affinity consistent-hash ring.",
+            )
+            metrics.describe(
+                "tfk8s_disagg_handoffs_total",
+                "Prefill->decode KV handoffs brokered by the gateway, "
+                "by outcome.",
+            )
+            metrics.describe(
+                "tfk8s_disagg_handoff_seconds",
+                "Wall time of one KV handoff transfer (serialize + "
+                "verify + deserialize).",
+            )
+            metrics.describe(
+                "tfk8s_disagg_handoff_bytes",
+                "Wire size of one KV handoff buffer.",
+            )
         self.stopping = threading.Event()
         self._states: Dict[Tuple[str, str], _ServeState] = {}
         self._states_lock = threading.Lock()
@@ -451,6 +517,10 @@ class GatewayServer(ThreadingHTTPServer):
         # in-flight request table for /debug/requests (span id -> row)
         self._inflight: Dict[str, Dict[str, Any]] = {}
         self._inflight_lock = threading.Lock()
+        # the KV handoff seam: one box, the transfer is a serialize/
+        # verify/deserialize memcpy; a real-TPU deployment injects a
+        # device-to-device KVTransport here instead
+        self.transport: KVTransport = LocalKVTransport()
         # route tables learn of drains the instant replicas unregister
         self._drain_hook: Callable[[str], None] = self._on_drain
         serving.add_drain_hook(self._drain_hook)
@@ -479,7 +549,10 @@ class GatewayServer(ThreadingHTTPServer):
 
     def _on_drain(self, key: str) -> None:
         with self._states_lock:
-            tables = [s.table for s in self._states.values()]
+            tables = [
+                t for s in self._states.values()
+                for _, t in s.named_tables()
+            ]
         for table in tables:
             table.mark_draining(key)
 
@@ -527,14 +600,31 @@ class GatewayServer(ThreadingHTTPServer):
             with self._states_lock:
                 self._states.pop((namespace, name), None)
             raise
+        disagg = serve.spec.disaggregation is not None
         with self._states_lock:
             state = self._states.get((namespace, name))
-            if state is None:
-                state = _ServeState(RouteTable(
-                    self._cs, name, namespace, metrics=self.metrics,
-                ))
+            if state is None or state.disagg != disagg:
+                # (re)build: flipping disaggregation on/off swaps the
+                # routing topology wholesale (the pods rolled anyway —
+                # the block is part of the template hash)
+                if disagg:
+                    prefill = RouteTable(
+                        self._cs, name, namespace, metrics=self.metrics,
+                        phase="prefill", affinity=True,
+                    )
+                    decode = RouteTable(
+                        self._cs, name, namespace, metrics=self.metrics,
+                        phase="decode",
+                    )
+                    state = _ServeState(prefill, prefill=prefill,
+                                        decode=decode)
+                else:
+                    state = _ServeState(RouteTable(
+                        self._cs, name, namespace, metrics=self.metrics,
+                    ))
                 self._states[(namespace, name)] = state
             state.queue_limit = serve.spec.batching.queue_limit
+            state.page_size = serve.spec.batching.page_size
             state.fetched = now
         state.admission.configure(serve.spec.tenancy)
         return state
@@ -546,15 +636,27 @@ class GatewayServer(ThreadingHTTPServer):
             })
 
     def dispatch(self, namespace: str, name: str, tenant: str,
-                 payload: Any, timeout: float) -> Any:
+                 payload: Any, timeout: float,
+                 session: Optional[str] = None,
+                 meta: Optional[Dict[str, str]] = None) -> Any:
         """Admit, route least-loaded, submit; absorb Draining, vanished,
         and CRASHED replicas by re-routing to a survivor inside the
         deadline. A serve request is idempotent (a pure function of its
         payload), so a mid-flight transport failure is retriable —
         bounded per request by MAX_DISPATCH_RETRIES and fleet-wide by
         the serve's token-bucket retry budget. Every attempt's outcome
-        feeds the router's health state machine."""
+        feeds the router's health state machine.
+
+        Disaggregated serves take the two-phase path instead: affine
+        prefill, gateway-brokered KV handoff, least-loaded decode.
+        ``session`` is the caller's sticky token; ``meta`` (when given)
+        returns ``{"session": key}`` for the response header."""
         state = self.state_for(namespace, name)
+        if state.disagg:
+            return self._dispatch_disagg(
+                state, namespace, name, tenant, payload, timeout,
+                session=session, meta=meta,
+            )
         serve_label = f"{namespace}/{name}"
         deadline = time.monotonic() + timeout
         t0 = time.perf_counter()
@@ -669,3 +771,208 @@ class GatewayServer(ThreadingHTTPServer):
                     state.table.release(key)
         finally:
             release()
+
+    def _dispatch_disagg(self, state: _ServeState, namespace: str,
+                         name: str, tenant: str, payload: Any,
+                         timeout: float, session: Optional[str] = None,
+                         meta: Optional[Dict[str, str]] = None) -> Any:
+        """The disaggregated request path: (1) prefill on the affinity
+        ring's owner of the prompt's page-aligned prefix digest (warm KV
+        prefix reuse), (2) a gateway-brokered KV page handoff, (3)
+        decode on the least-loaded decode replica. The gateway holds the
+        buffer between phases, so a decode replica dying mid-transfer is
+        absorbed by re-picking a survivor — the prefill work is never
+        repeated for a decode-side failure."""
+        serve_label = f"{namespace}/{name}"
+        deadline = time.monotonic() + timeout
+        t0 = time.perf_counter()
+        tracer = get_tracer()
+        span = tracer.current_span()
+        traceparent = span.traceparent if span is not None else None
+        priority = state.admission.priority_of(tenant)
+        # the affinity key: an explicit session token wins (follow-up
+        # turns keep their pin even as the shared history grows past the
+        # first page); otherwise the page-aligned prefix digest of the
+        # prompt itself (co-locates prompts sharing a system prefix)
+        akey: Optional[str] = (session or "").strip() or None
+        if akey is None:
+            raw = payload.get("tokens") if isinstance(payload, dict) else payload
+            try:
+                toks = [int(t) for t in raw] if raw is not None else []
+            except (TypeError, ValueError):
+                toks = []
+            if toks:
+                akey = affinity_key_of(toks, state.page_size)
+        if meta is not None and akey:
+            meta["session"] = akey
+        release = state.admission.admit(
+            tenant, state.prefill.least_depth(), state.queue_limit
+        )
+        try:
+            prefill_res = self._run_phase(
+                state, state.prefill, serve_label, tenant, deadline,
+                timeout, t0, span, akey,
+                lambda srv, rem: srv.submit_prefill(
+                    payload, timeout=rem, traceparent=traceparent,
+                    tenant=tenant, priority=priority,
+                ),
+            )
+            buf = prefill_res["handoff"]
+            nbytes = 0
+            outcome = "ok"
+            ht0 = time.perf_counter()
+            try:
+                with tracer.start_span("handoff.transfer", attributes={
+                    "serve": serve_label,
+                    "pages": buf.n_pages,
+                }) as hs:
+                    buf, nbytes = self.transport.transfer(buf)
+                    hs.set_attribute("bytes", nbytes)
+            except HandoffError:
+                outcome = "corrupt"
+                raise
+            finally:
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "tfk8s_disagg_handoffs_total", 1.0,
+                        {"serve": serve_label, "outcome": outcome},
+                    )
+                    self.metrics.observe(
+                        "tfk8s_disagg_handoff_seconds",
+                        time.perf_counter() - ht0, {"serve": serve_label},
+                    )
+                    if nbytes:
+                        self.metrics.observe(
+                            "tfk8s_disagg_handoff_bytes", float(nbytes),
+                            {"serve": serve_label},
+                        )
+            return self._run_phase(
+                state, state.decode, serve_label, tenant, deadline,
+                timeout, None, span, None,
+                lambda srv, rem: srv.submit_handoff(
+                    buf, timeout=rem, traceparent=traceparent,
+                    tenant=tenant, priority=priority,
+                ),
+            )
+        finally:
+            release()
+
+    def _run_phase(self, state: _ServeState, table: RouteTable,
+                   serve_label: str, tenant: str, deadline: float,
+                   timeout: float, t0: Optional[float], span,
+                   affinity_key: Optional[str], call) -> Any:
+        """One phase of a disaggregated dispatch: the pick/submit/retry
+        loop of :meth:`dispatch`, against ONE pool's route table.
+        ``call(server, remaining)`` performs the phase's submit; the
+        loop owns routing, outcome feedback, Draining/vanished/crash
+        re-dispatch, and the typed surfacing contract."""
+        phase = table.phase or "serve"
+        exclude: set = set()
+        tried: list = []
+        transport_retries = 0
+        backoff = 0.005
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                exc = DeadlineExceeded(
+                    f"no {phase} replica of {serve_label} served the "
+                    f"request within {timeout}s"
+                )
+                exc.tried = list(tried)
+                raise exc
+            key = table.pick(exclude, affinity_key=affinity_key)
+            if key is None:
+                if exclude:
+                    exclude = set()  # full rescan before backing off
+                    continue
+                if timeout - remaining + backoff > timeout * 0.5:
+                    exc = Unavailable(
+                        f"{serve_label}: no routable {phase} replica"
+                    )
+                    exc.tried = list(tried)
+                    raise exc
+                time.sleep(min(backoff, remaining))
+                backoff = min(backoff * 2, 0.25)
+                continue
+            server = lookup_replica(key)
+            if server is None:
+                table.release(key)
+                table.remove(key, "ejected")
+                if span is not None:
+                    span.add_event("replica.vanished", {"replica": key})
+                exclude.add(key)
+                continue
+            submit_t0 = time.perf_counter()
+            try:
+                if t0 is not None and self.metrics is not None:
+                    # admission+routing delay lands once, on the phase
+                    # requests enter first (prefill)
+                    self.metrics.observe(
+                        "tfk8s_gateway_queue_seconds",
+                        time.perf_counter() - t0, {"serve": serve_label},
+                    )
+                    t0 = None
+                result = call(server, remaining)
+                table.report_outcome(
+                    key, "ok", time.perf_counter() - submit_t0
+                )
+                return result
+            except Draining:
+                self._count_retry(serve_label, tenant, "draining")
+                if span is not None:
+                    span.add_event("retry", {
+                        "reason": "Draining", "replica": key,
+                        "phase": phase, "backoff_s": 0.0,
+                    })
+                exclude.add(key)
+                continue
+            except DeadlineExceeded as exc:
+                table.report_outcome(key, "deadline")
+                tried.append(key)
+                exc.tried = list(tried)
+                raise
+            except (ReplicaUnavailable, OSError) as exc:
+                # the phase target died mid-flight. For decode this is
+                # the handoff-target-dies case: the gateway still holds
+                # the buffer, so a survivor takes the SAME handoff
+                table.report_outcome(key, "transport_error")
+                tried.append(key)
+                exclude.add(key)
+                transport_retries += 1
+                if (transport_retries <= MAX_DISPATCH_RETRIES
+                        and state.retry_budget.try_accept()):
+                    self._count_retry(serve_label, tenant, "transport")
+                    if span is not None:
+                        span.add_event("retry", {
+                            "reason": "ReplicaUnavailable",
+                            "replica": key, "phase": phase,
+                        })
+                    continue
+                wrapped = ReplicaUnavailable(
+                    f"{serve_label}: {phase} replica {key} failed "
+                    f"mid-flight ({exc}) with the retry budget exhausted"
+                )
+                wrapped.tried = list(tried)
+                raise wrapped from exc
+            finally:
+                table.release(key)
+
+    # -- /debug/routes -------------------------------------------------------
+
+    def debug_routes(self) -> Dict[str, Any]:
+        """The ``/debug/routes`` zpage body: every serve's route table(s)
+        — replica, health state, effective depth, in-flight — plus the
+        affinity ring's ownership map where prefix routing is on."""
+        with self._states_lock:
+            items = list(self._states.items())
+        serves: Dict[str, Any] = {}
+        for (ns, name), st in items:
+            entry: Dict[str, Any] = {}
+            for phase, table in st.named_tables():
+                block: Dict[str, Any] = {"replicas": table.debug_rows()}
+                ring = table.ring_describe()
+                if ring is not None:
+                    block["ring"] = ring
+                entry[phase or "default"] = block
+            serves[f"{ns}/{name}"] = entry
+        return {"serves": serves}

@@ -1,0 +1,199 @@
+"""KV page handoff between the prefill and decode pools (disaggregated
+serving, Splitwise/DistServe-style).
+
+A prefill-pool replica runs chunked prefill to completion, picks the
+request's FIRST output token, then exports the warm KV state —
+:class:`KVHandoffBuffer` carries the prompt, the first token, the
+generation budget, the page-aligned prefix digest chain
+(runtime/paging.prefix_digest_chain), and the per-layer K/V rows of
+every prompt page. A decode-pool replica imports the buffer straight
+into a :class:`~tfk8s_tpu.runtime.server.DecodeLoopExecutor` slot: the
+row starts decoding at position ``len(tokens)`` with the prefill
+replica's pick as its last token, bit-identical to having prefilled
+locally (same params — ``version`` is checked — same K/V bytes, same
+packed decode step; test-pinned against single-replica
+``gpt.generate``).
+
+The buffer is SELF-DESCRIBING — a fixed magic, a JSON header (shapes,
+dtypes, tokens, digests), then the raw leaf bytes — so the transfer
+seam is a dumb byte mover. :class:`KVTransport` is that seam:
+:class:`LocalKVTransport` is the one-box memcpy implementation (a
+serialize/deserialize round trip, which is also what proves the buffer
+self-describes). On a real TPU pod the same interface fronts the
+device-to-device path: the exporter's pages are already contiguous
+``[page*ps, (page+1)*ps)`` row ranges of the pool leaves, so a
+production transport maps each leaf slice to one ICI/DMA transfer
+(or a NIC send between pools on different slices) and skips the host
+round trip entirely — the header still travels, the K/V bytes move
+device-to-device.
+
+Integrity is end-to-end, not transport-trusted: :meth:`KVHandoffBuffer
+.verify` recomputes the digest chain from the tokens it carries and
+refuses a buffer whose chain (or leaf sizes) don't match —
+:class:`HandoffError`, a typed wire error the gateway maps like any
+other dispatch failure (re-pick a decode replica, bounded retries).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, List, Tuple
+
+from tfk8s_tpu.runtime.paging import prefix_digest_chain
+
+#: buffer wire format identity (bump on layout change)
+MAGIC = b"TFK8SKV1"
+
+
+class HandoffError(Exception):
+    """A KV handoff buffer that cannot be imported: corrupt framing,
+    digest-chain mismatch, or a shape/version that doesn't match the
+    importing replica. The gateway treats it like a failed dispatch hop:
+    the buffer it still holds is re-sent to another decode replica
+    under the bounded retry budget."""
+
+
+@dataclass(eq=False)
+class KVHandoffBuffer:
+    """One request's warm prefill state, ready to cross the pool seam."""
+
+    #: model identity (the serve checkpoint ref) — import refuses a
+    #: buffer prefilled under different params; bit-identity would break
+    version: str
+    page_size: int
+    #: full prompt (plain ints — hashable identically on both sides)
+    tokens: List[int]
+    #: the first OUTPUT token, picked at the last prompt position
+    last_token: int
+    #: decode-side generation budget (the first token counts against it)
+    gen_budget: int
+    #: chained digests of the FULL prompt pages (integrity + affinity)
+    digests: List[str] = field(default_factory=list)
+    #: per-layer K/V leaves in tree order, each
+    #: ``[n_prompt_pages * page_size, heads, head_dim]`` — page ``k`` of
+    #: the prompt is rows ``[k*ps, (k+1)*ps)`` of every leaf
+    kv: List[Any] = field(default_factory=list)
+
+    @property
+    def n_pages(self) -> int:
+        """Prompt pages carried (including a trailing partial page)."""
+        return -(-len(self.tokens) // self.page_size)
+
+    def verify(self) -> None:
+        """End-to-end integrity: recompute the digest chain from the
+        tokens the buffer carries and check every leaf covers exactly
+        the prompt pages. Raises :class:`HandoffError` on any mismatch."""
+        if self.page_size < 1 or not self.tokens:
+            raise HandoffError(
+                f"malformed buffer: page_size={self.page_size}, "
+                f"{len(self.tokens)} token(s)"
+            )
+        want = prefix_digest_chain(
+            self.tokens, self.page_size, len(self.tokens) // self.page_size
+        )
+        if list(self.digests) != want:
+            raise HandoffError(
+                "digest chain mismatch — buffer tokens and K/V disagree "
+                f"({len(self.digests)} carried vs {len(want)} recomputed)"
+            )
+        rows = self.n_pages * self.page_size
+        for i, leaf in enumerate(self.kv):
+            if getattr(leaf, "shape", (None,))[0] != rows:
+                raise HandoffError(
+                    f"kv leaf {i} covers {getattr(leaf, 'shape', None)} — "
+                    f"expected {rows} prompt rows"
+                )
+
+    # -- wire form -----------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        """MAGIC + u32 header length + JSON header + raw leaf bytes
+        (C-order, header order). Self-describing: the importer needs
+        nothing but these bytes."""
+        import numpy as np
+
+        leaves = [np.ascontiguousarray(leaf) for leaf in self.kv]
+        header = json.dumps({
+            "version": self.version,
+            "page_size": self.page_size,
+            "tokens": [int(t) for t in self.tokens],
+            "last_token": int(self.last_token),
+            "gen_budget": int(self.gen_budget),
+            "digests": list(self.digests),
+            "leaves": [
+                {"dtype": str(leaf.dtype), "shape": list(leaf.shape)}
+                for leaf in leaves
+            ],
+        }).encode()
+        parts = [MAGIC, len(header).to_bytes(4, "big"), header]
+        parts.extend(leaf.tobytes() for leaf in leaves)
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KVHandoffBuffer":
+        """Decode and :meth:`verify` a serialized buffer."""
+        import numpy as np
+
+        if data[: len(MAGIC)] != MAGIC:
+            raise HandoffError("not a KV handoff buffer (bad magic)")
+        off = len(MAGIC)
+        hlen = int.from_bytes(data[off:off + 4], "big")
+        off += 4
+        try:
+            header = json.loads(data[off:off + hlen].decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise HandoffError(f"corrupt buffer header: {e}") from e
+        off += hlen
+        kv = []
+        for spec in header.get("leaves", []):
+            dtype = np.dtype(spec["dtype"])
+            shape = tuple(spec["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            end = off + count * dtype.itemsize
+            if end > len(data):
+                raise HandoffError("truncated buffer (leaf bytes missing)")
+            kv.append(
+                np.frombuffer(data[off:end], dtype=dtype).reshape(shape)
+            )
+            off = end
+        buf = cls(
+            version=header.get("version", ""),
+            page_size=int(header.get("page_size", 0)),
+            tokens=list(header.get("tokens", [])),
+            last_token=int(header.get("last_token", 0)),
+            gen_budget=int(header.get("gen_budget", 0)),
+            digests=list(header.get("digests", [])),
+            kv=kv,
+        )
+        buf.verify()
+        return buf
+
+
+class KVTransport:
+    """The pool-to-pool seam. ``transfer`` moves one buffer and returns
+    ``(buffer_at_destination, bytes_moved)``. Implementations own HOW the
+    bytes move; callers own the retry/rerouting policy around it."""
+
+    def transfer(self, buf: KVHandoffBuffer) -> Tuple[KVHandoffBuffer, int]:
+        raise NotImplementedError
+
+
+class LocalKVTransport(KVTransport):
+    """One-box transport: a full serialize/deserialize round trip (the
+    memcpy seam). Deliberately NOT a pass-through of the live object —
+    the round trip is what proves the buffer self-describes and what a
+    real device-to-device transport replaces."""
+
+    def transfer(self, buf: KVHandoffBuffer) -> Tuple[KVHandoffBuffer, int]:
+        wire = buf.to_bytes()
+        return KVHandoffBuffer.from_bytes(wire), len(wire)
+
+
+__all__ = [
+    "HandoffError",
+    "KVHandoffBuffer",
+    "KVTransport",
+    "LocalKVTransport",
+    "MAGIC",
+]

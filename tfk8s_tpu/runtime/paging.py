@@ -36,6 +36,23 @@ from typing import Dict, List, Optional, Sequence, Tuple
 TRASH_PAGE = 0
 
 
+def prefix_digest_chain(tokens: Sequence[int], page_size: int,
+                        upto: int) -> List[str]:
+    """Chained content digests for the first ``upto`` full pages of
+    ``tokens``: page ``k``'s digest folds in every page before it, so a
+    digest identifies a whole page-aligned PREFIX, not one page in
+    isolation. Module-level because three layers key off the same chain:
+    the prefix cache (here), KV handoff integrity (runtime/handoff.py),
+    and prefix-affinity routing (gateway/affinity.py) — the gateway must
+    hash a prompt exactly the way the replica's cache will."""
+    digests, h = [], b""
+    for k in range(upto):
+        page = [int(t) for t in tokens[k * page_size:(k + 1) * page_size]]
+        h = hashlib.sha256(h + repr(page).encode()).digest()
+        digests.append(h.hex())
+    return digests
+
+
 class OutOfPages(Exception):
     """The pool cannot cover a new request's worst-case page budget.
     Admission-time only: the caller keeps the request queued and retries
@@ -126,13 +143,7 @@ class PageAllocator:
         """Chained content digests for the first ``upto`` full pages.
         Tokens are normalized to plain ints so a numpy prompt and a list
         prompt with the same content hash identically."""
-        ps = self.page_size
-        digests, h = [], b""
-        for k in range(upto):
-            page = [int(t) for t in tokens[k * ps:(k + 1) * ps]]
-            h = hashlib.sha256(h + repr(page).encode()).digest()
-            digests.append(h.hex())
-        return digests
+        return prefix_digest_chain(tokens, self.page_size, upto)
 
     def match_prefix(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
         """Longest cached page chain covering a PROPER prefix of
@@ -246,6 +257,45 @@ class PageAllocator:
         lease.pages = []
         lease.cached_pages = 0
 
+    # -- KV handoff (disaggregated prefill/decode) --------------------------
+
+    def export_pages(
+        self, lease: SlotLease, tokens: Sequence[int]
+    ) -> Tuple[List[int], List[str]]:
+        """The prefill side of a KV handoff: the lease's page ids covering
+        the PROMPT (in table order — what runtime/handoff.py serializes
+        together with the digest chain into a self-describing buffer) plus
+        the chained digests of the full prompt pages, which double as the
+        buffer's integrity check and the gateway's affinity key. The
+        trailing partial page (if the prompt isn't page-aligned) is
+        exported too — its live rows are prompt K/V; its tail rows are
+        junk the importer's decode never reads (attention is masked to
+        positions <= the current one, exactly as on this replica)."""
+        ps = self.page_size
+        n_prompt = -(-len(tokens) // ps)
+        assert len(lease.pages) >= n_prompt, (
+            f"lease holds {len(lease.pages)} page(s), prompt needs "
+            f"{n_prompt} — export before prefill drew the lease"
+        )
+        digests = prefix_digest_chain(tokens, ps, len(tokens) // ps)
+        return list(lease.pages[:n_prompt]), digests
+
+    def import_pages(self, tokens: Sequence[int], gen_budget: int) -> SlotLease:
+        """The decode side of a KV handoff: admit the row exactly like
+        :meth:`admit` (worst-case reservation, prefix-cache reuse — a
+        repeated session history that is already cached locally is NOT
+        re-copied), then draw the remaining prompt pages immediately so
+        the imported K/V has somewhere to land BEFORE the row's first
+        decode step. The caller copies buffer pages
+        ``[lease.cached_pages, ceil(len(tokens)/page_size))`` into
+        ``lease.pages[cached_pages:]``. Raises :class:`OutOfPages`
+        without side effects when the pool cannot cover the row."""
+        lease = self.admit(tokens, gen_budget)
+        n_prompt = -(-len(tokens) // self.page_size)
+        while len(lease.pages) < n_prompt:
+            self.extend(lease)
+        return lease
+
     # -- fault quarantine ---------------------------------------------------
 
     @property
@@ -308,4 +358,10 @@ class PageAllocator:
         raise OutOfPages("no idle cached page to evict — accounting bug")
 
 
-__all__ = ["OutOfPages", "PageAllocator", "SlotLease", "TRASH_PAGE"]
+__all__ = [
+    "OutOfPages",
+    "PageAllocator",
+    "SlotLease",
+    "TRASH_PAGE",
+    "prefix_digest_chain",
+]

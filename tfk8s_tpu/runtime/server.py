@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tfk8s_tpu.obs import trace as _trace
 from tfk8s_tpu.runtime import progress as _progress
+from tfk8s_tpu.runtime.handoff import HandoffError, KVHandoffBuffer
 from tfk8s_tpu.utils.logging import Metrics, get_logger
 
 log = get_logger("serve")
@@ -437,6 +438,25 @@ class PagedGptDecoder:
                 cfg, params, pages, batch
             )
         )
+        # KV handoff seam (ISSUE 14): gather/scatter the whole KV tree
+        # in ONE XLA program per transfer. The eager per-leaf versions
+        # paid a dispatch (and a full pool copy on import) per leaf —
+        # measured ~30x slower on the 1-core box, enough to put a
+        # handoff import on par with ~15 decode steps of loop stall.
+        # Compiles once per distinct page-count, like prefill chunks.
+        self._export_fn = jax.jit(
+            lambda pages, idx: [
+                leaf[idx] for leaf in jax.tree_util.tree_leaves(pages)
+            ]
+        )
+
+        def _scatter_kv(pages, srcs, idx):
+            leaves, treedef = jax.tree_util.tree_flatten(pages)
+            return jax.tree_util.tree_unflatten(
+                treedef, [l.at[idx].set(s) for l, s in zip(leaves, srcs)]
+            )
+
+        self._import_fn = jax.jit(_scatter_kv)
         # Precompile all three serving shapes NOW (decode [slots], burst
         # prefill [slots, C], trickle prefill [1, C]) against the trash
         # page, so Ready means COMPILED — the first admission burst never
@@ -530,6 +550,54 @@ class PagedGptDecoder:
         nxt, new_state, self._pages = self._decode_fn(self._pages, state)
         return nxt, new_state
 
+    # -- KV handoff seam (runtime/handoff.py) --------------------------------
+
+    def export_kv(self, page_ids):
+        """Copy the K/V rows of ``page_ids`` out of the page pool as
+        numpy leaves (tree order). The pool leaves are FLAT along the
+        token axis — page ``pid`` is rows ``[pid*ps, (pid+1)*ps)`` — so
+        each exported leaf is the buffer's contiguous
+        ``[n_pages*ps, heads, head_dim]`` block. All leaves gather in
+        one jitted program, then sync to host; a device-to-device
+        transport reads the same row ranges without the host hop."""
+        import jax
+        import numpy as np
+
+        ps = self.page_size
+        idx = np.concatenate(
+            [np.arange(p * ps, (p + 1) * ps) for p in page_ids]
+        )
+        return [np.asarray(leaf) for leaf in self._export_fn(self._pages, idx)]
+
+    def import_kv(self, kv_leaves, page_ids) -> None:
+        """Land exported K/V rows into THIS replica's pool at
+        ``page_ids`` (same order as :meth:`export_kv` wrote them). The
+        write is a scatter into rows no live slot's page table points
+        at, so sibling rows are untouched by construction."""
+        import jax
+        import numpy as np
+
+        ps = self.page_size
+        leaves, treedef = jax.tree_util.tree_flatten(self._pages)
+        if len(kv_leaves) != len(leaves):
+            raise HandoffError(
+                f"buffer carries {len(kv_leaves)} kv leaves, model has "
+                f"{len(leaves)} — incompatible model config"
+            )
+        idx = np.concatenate(
+            [np.arange(p * ps, (p + 1) * ps) for p in page_ids]
+        )
+        for i, (leaf, src) in enumerate(zip(leaves, kv_leaves)):
+            if (
+                tuple(src.shape[1:]) != tuple(leaf.shape[1:])
+                or src.shape[0] != len(idx)
+            ):
+                raise HandoffError(
+                    f"kv leaf {i} is {tuple(src.shape)}, pool expects "
+                    f"[{len(idx)}, {', '.join(map(str, leaf.shape[1:]))}]"
+                )
+        self._pages = self._import_fn(self._pages, list(kv_leaves), idx)
+
 
 @dataclass(eq=False)  # identity semantics: deque.remove / slots.index
 class _GenRequest:
@@ -552,6 +620,15 @@ class _GenRequest:
     cached_pages: int = 0        # prefix-cache pages reused at admission
     prefill_chunks: int = 0      # chunk rounds this request rode
     token_times: List[float] = field(default_factory=list)
+    # disaggregated serving (runtime/handoff.py): a prefill-pool request
+    # stops after its first output token and exports the warm KV
+    # (prefill_only + decode_budget -> exported buffer rides the
+    # result); a decode-pool request arrives WITH a buffer (handoff) and
+    # skips prefill entirely
+    prefill_only: bool = False
+    decode_budget: int = 0
+    handoff: Optional[KVHandoffBuffer] = None
+    exported: Optional[KVHandoffBuffer] = None
 
     def wall(self, t: float) -> float:
         """Map a perf_counter stamp onto the wall clock."""
@@ -672,6 +749,15 @@ class DecodeLoopExecutor:
              "KV pages held (leases + prefix cache) / usable pool."),
             ("tfk8s_serving_prefix_cache_hits_total",
              "Admissions that reused cached prompt-prefix pages."),
+            ("tfk8s_serving_prefix_cache_misses_total",
+             "Admissions that found no cached prompt prefix and "
+             "prefilled from scratch."),
+            ("tfk8s_disagg_exports_total",
+             "Prefill-pool requests whose warm KV was exported as a "
+             "handoff buffer."),
+            ("tfk8s_disagg_imports_total",
+             "Handoff buffers imported directly into decode slots "
+             "(no local prefill)."),
         ):
             self.metrics.describe(name, help_text)
 
@@ -750,6 +836,86 @@ class DecodeLoopExecutor:
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
         )
+        return self._enqueue_and_wait(req, timeout)
+
+    def submit_prefill(self, payload: Any, timeout: Optional[float] = 30.0,
+                       traceparent: Optional[str] = None, tenant: str = "",
+                       priority: int = 0) -> Any:
+        """Prefill-pool entry point (disaggregated serving): run chunked
+        prefill to completion, pick the FIRST output token, export the
+        warm KV, and retire — same typed contract as :meth:`submit`, but
+        the result additionally carries ``{"handoff":
+        KVHandoffBuffer}`` for the gateway to move to a decode replica.
+        The request's real generation budget rides the buffer
+        (``decode_budget``); THIS replica only ever holds the row for
+        one output token."""
+        try:
+            tokens, gen = self.model.validate(payload)
+        except InvalidRequest:
+            self.metrics.inc(
+                "tfk8s_serving_requests_total", 1.0,
+                {**self.labels, "outcome": "invalid"},
+            )
+            raise
+        if self._chaos_delay_s:
+            time.sleep(self._chaos_delay_s)
+        req = _GenRequest(
+            tokens=tokens, gen_budget=1, enqueue_t=time.perf_counter(),
+            traceparent=traceparent or "", tenant=tenant,
+            priority=int(priority), wall_start=time.time(),
+            prefill_only=True, decode_budget=gen,
+        )
+        return self._enqueue_and_wait(req, timeout)
+
+    def submit_handoff(self, buf: KVHandoffBuffer,
+                       timeout: Optional[float] = 30.0,
+                       traceparent: Optional[str] = None, tenant: str = "",
+                       priority: int = 0) -> Any:
+        """Decode-pool entry point (disaggregated serving): admit a row
+        whose prefill already happened elsewhere. The buffer's K/V pages
+        land in freshly drawn local pages (prefix-cached pages are NOT
+        re-copied), the slot seeds at position ``len(tokens)`` with the
+        prefill replica's pick, and decoding continues bit-identically
+        to a local prefill. Raises :class:`HandoffError` on a buffer
+        this replica cannot import (wrong page size / model version /
+        integrity failure); otherwise the :meth:`submit` contract."""
+        import numpy as np
+
+        buf.verify()
+        if buf.page_size != self.model.page_size:
+            raise HandoffError(
+                f"buffer page_size={buf.page_size}, replica runs "
+                f"{self.model.page_size}"
+            )
+        if buf.version != self.model.version:
+            raise HandoffError(
+                f"buffer prefilled under {buf.version!r}, replica serves "
+                f"{self.model.version!r} — params differ, refusing a "
+                f"non-bit-identical import"
+            )
+        tokens = np.asarray(buf.tokens, np.int32)
+        gen = int(buf.gen_budget)
+        if gen < 1:
+            raise InvalidRequest(f"gen_budget must be >= 1, got {gen}")
+        if len(tokens) + gen > self.model.max_len:
+            raise InvalidRequest(
+                f"prompt of {len(tokens)} + {gen} generated tokens "
+                f"exceeds max_len={self.model.max_len}"
+            )
+        if self._chaos_delay_s:
+            time.sleep(self._chaos_delay_s)
+        req = _GenRequest(
+            tokens=tokens, gen_budget=gen, enqueue_t=time.perf_counter(),
+            traceparent=traceparent or "", tenant=tenant,
+            priority=int(priority), wall_start=time.time(),
+            handoff=buf,
+        )
+        return self._enqueue_and_wait(req, timeout)
+
+    def _enqueue_and_wait(self, req: _GenRequest,
+                          timeout: Optional[float]) -> Any:
+        """The shared back half of every submit flavor: bounded-queue
+        admission, deadline wait, typed re-raise."""
         with self._cond:
             if self._fault is not None:
                 raise ReplicaUnavailable(f"replica failed: {self._fault}")
@@ -813,13 +979,25 @@ class DecodeLoopExecutor:
         while self._q and self._live < len(self._slots):
             req = self._q[0]
             try:
-                lease = self.allocator.admit(req.tokens, req.gen_budget)
+                if req.handoff is not None:
+                    # handoff rows draw their prompt pages NOW so the
+                    # imported K/V has somewhere to land before step 1
+                    lease = self.allocator.import_pages(
+                        req.tokens, req.gen_budget
+                    )
+                else:
+                    lease = self.allocator.admit(req.tokens, req.gen_budget)
             except OutOfPages:
                 break  # admission stalls; retirements will free pages
             self._q.popleft()
             if lease.cached_pages:
                 self.metrics.inc(
                     "tfk8s_serving_prefix_cache_hits_total", 1.0, self.labels
+                )
+            elif self.allocator.prefix_cache_enabled:
+                self.metrics.inc(
+                    "tfk8s_serving_prefix_cache_misses_total", 1.0,
+                    self.labels,
                 )
             req.cached_pages = lease.cached_pages
             req.dequeue_t = time.perf_counter()
@@ -874,6 +1052,21 @@ class DecodeLoopExecutor:
         output token is its pick at the last real prompt position."""
         import numpy as np
 
+        # Handoff rows (disaggregated serving) skip prefill entirely:
+        # their K/V arrives in the buffer and lands by page copy. A
+        # buffer that fails import indicts THAT row only — retire it
+        # typed with its pages quarantined (they may hold a partial
+        # foreign write), siblings untouched.
+        imports = [s for s in admitted if s.req.handoff is not None]
+        admitted = [s for s in admitted if s.req.handoff is None]
+        for slot in imports:
+            try:
+                self._import_handoff(slot)
+            except HandoffError as e:
+                self._retire_failed(
+                    slot, RowFault(f"handoff import failed: {e}")
+                )
+
         n, mpp = len(self._slots), self.model.pages_per_slot
         chunk_len, ps = self.model.prefill_chunk, self.model.page_size
         # Draw the WHOLE lease up front (admission already reserved it,
@@ -927,6 +1120,23 @@ class DecodeLoopExecutor:
                 self.metrics.inc(
                     "tfk8s_serving_tokens_total", 1.0, self.labels
                 )
+                if req.prefill_only:
+                    # export BEFORE retire frees the lease's pages: the
+                    # decode pool gets the warm K/V plus the pick
+                    page_ids, digests = self.allocator.export_pages(
+                        slot.lease, req.tokens
+                    )
+                    req.exported = KVHandoffBuffer(
+                        version=self.model.version, page_size=ps,
+                        tokens=[int(t) for t in req.tokens],
+                        last_token=first_tok,
+                        gen_budget=req.decode_budget,
+                        digests=digests,
+                        kv=self.model.export_kv(page_ids),
+                    )
+                    self.metrics.inc(
+                        "tfk8s_disagg_exports_total", 1.0, self.labels
+                    )
                 if len(req.out) >= req.gen_budget or (
                     self.model.eos_id is not None
                     and first_tok == self.model.eos_id
@@ -934,6 +1144,40 @@ class DecodeLoopExecutor:
                     self._retire(slot)
             pending = [e for e in pending if e[1] < len(e[0].req.tokens)]
         self._state_dirty = True  # admitted rows changed under the state
+
+    def _import_handoff(self, slot: _Slot) -> None:
+        """Admit a prefilled-elsewhere row: copy the buffer's K/V into
+        the locally drawn prompt pages (prefix-cached pages are already
+        resident — only the uncovered tail copies), seed the slot at the
+        prompt's end with the prefill replica's pick, and let the next
+        decode step continue bit-identically to a local prefill."""
+        req = slot.req
+        buf = req.handoff
+        ps = self.model.page_size
+        plen = len(req.tokens)
+        # whole lease up front, like the prefill path: the page table
+        # never grows mid-decode
+        self._pages_for(slot, plen + max(req.gen_budget, 1))
+        n_prompt = -(-plen // ps)
+        dst = slot.lease.pages[slot.lease.cached_pages:n_prompt]
+        if dst:
+            row0 = slot.lease.cached_pages * ps
+            self.model.import_kv(
+                [leaf[row0:n_prompt * ps] for leaf in buf.kv], dst
+            )
+        self.allocator.register_prefix(req.tokens, slot.lease)
+        slot.position = plen
+        slot.last_token = buf.last_token
+        req.out.append(buf.last_token)
+        req.first_token_t = time.perf_counter()
+        # the first token was generated (and counted in the token
+        # metrics) on the PREFILL replica; importing it emits nothing
+        self.metrics.inc("tfk8s_disagg_imports_total", 1.0, self.labels)
+        if len(req.out) >= req.gen_budget or (
+            self.model.eos_id is not None
+            and buf.last_token == self.model.eos_id
+        ):
+            self._retire(slot)
 
     def _rebuild_state(self) -> None:
         """Re-materialize the packed step state from the slot mirrors —
@@ -1070,6 +1314,10 @@ class DecodeLoopExecutor:
             "ttft_s": round(req.first_token_t - req.enqueue_t, 6)
             if req.first_token_t else None,
         }
+        if req.exported is not None:
+            # prefill-pool retirement: the warm KV rides the result to
+            # the gateway, which moves it across the pool seam
+            req.result["handoff"] = req.exported
         req.done.set()
 
     def _retire_reason(self, req: _GenRequest) -> str:
@@ -1320,6 +1568,17 @@ class DecodeLoopExecutor:
                 "pages_total": self.allocator.num_pages,
                 "served_total": self.served_total,
                 "tokens_total": self.tokens_total,
+                "prefix_cache": {
+                    "hits": self.allocator.prefix_hits,
+                    "misses": self.allocator.prefix_misses,
+                    "hit_ratio": round(
+                        self.allocator.prefix_hits
+                        / max(
+                            self.allocator.prefix_hits
+                            + self.allocator.prefix_misses, 1
+                        ), 4,
+                    ),
+                },
             }
 
     # -- load reporting (progress → pod status → autoscaler) ----------------
@@ -1893,6 +2152,15 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
     ns = env.get("TFK8S_NAMESPACE", "default")
     pod = env.get("TFK8S_POD_NAME", "")
     serve_name = env.get("TFK8S_SERVE_NAME", "")
+    # disaggregated serving: "prefill" / "decode" pool membership (empty
+    # for a single-pool serve). The executor is the SAME either way —
+    # the gateway decides which entry point (submit / submit_prefill /
+    # submit_handoff) a pool's replicas see; the phase only labels this
+    # replica's metrics so each pool's signals aggregate separately.
+    phase = env.get("TFK8S_SERVE_PHASE", "")
+    labels = {"serve": serve_name, "pod": pod}
+    if phase:
+        labels["phase"] = phase
     key = f"{ns}/{pod}"
 
     # generative tasks get the continuous-batching decode loop (token-
@@ -1921,7 +2189,7 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
             model,
             queue_limit=queue_limit,
             metrics=get_metrics(),
-            labels={"serve": serve_name, "pod": pod},
+            labels=labels,
             prefix_cache=env.get("TFK8S_SERVE_PREFIX_CACHE", "1") != "0",
         ).start()
     else:
@@ -1933,7 +2201,7 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
             batch_timeout_s=timeout_ms / 1000.0,
             queue_limit=queue_limit,
             metrics=get_metrics(),
-            labels={"serve": serve_name, "pod": pod},
+            labels=labels,
         ).start()
     register_replica(key, server)
     server.report_progress()
@@ -2134,6 +2402,8 @@ __all__ = [
     "Draining",
     "EchoModel",
     "GptGenerator",
+    "HandoffError",
+    "KVHandoffBuffer",
     "InvalidRequest",
     "MlpClassifier",
     "ModelServer",

@@ -20,6 +20,9 @@ CONTROLLER_NAME = "tpujob-operator"
 # pod-template-hash analogue).
 SERVE_NAME = "tfk8s.dev/serve-name"
 SERVE_VERSION = "tfk8s.dev/serve-version"
+# Disaggregated serving: which phase pool a replica belongs to
+# ("prefill" / "decode"; absent on single-pool serves)
+SERVE_PHASE = "tfk8s.dev/serve-phase"
 
 
 def job_selector(job_name: str) -> Dict[str, str]:
@@ -47,3 +50,8 @@ def serve_selector(serve_name: str) -> Dict[str, str]:
 
 def serve_version_labels(serve_name: str, version: str) -> Dict[str, str]:
     return {**serve_selector(serve_name), SERVE_VERSION: version}
+
+
+def serve_phase_selector(serve_name: str, phase: str) -> Dict[str, str]:
+    """Selector matching ONE phase pool of a disaggregated serve."""
+    return {**serve_selector(serve_name), SERVE_PHASE: phase}

@@ -87,26 +87,57 @@ AUTOSCALE_PERIOD_S = 1.0
 # EMA smoothing factor for the queue-depth signal: ~3 observations to
 # cross a band edge, so a single spiky flush can't trigger a scale.
 EMA_ALPHA = 0.4
+# Disaggregated decode pools scale on SLOT OCCUPANCY (live decode slots /
+# slot capacity), not queue depth: a decode pool's backlog shows up as
+# full batches long before a queue forms. Target fraction of capacity in
+# use; the autoscale bands apply multiplicatively around it.
+DECODE_TARGET_OCCUPANCY = 0.75
 
 
 def _serve_version(serve: TPUServe) -> str:
     """The pod-template hash: everything that, when changed, requires
-    replacing replicas (weights ref, code template, batching knobs)."""
-    return template_hash(
-        {
-            "task": serve.spec.task,
-            "checkpoint": serve.spec.checkpoint,
-            "template": serde.to_wire(serve.spec.template),
-            "batching": serde.to_wire(serve.spec.batching),
-        }
-    )
+    replacing replicas (weights ref, code template, batching knobs).
+    ``disaggregation`` joins the hash only when PRESENT (existing
+    single-pool hashes are unchanged), and only by presence: pool
+    COUNTS scale in place like ``spec.replicas`` — adding/removing the
+    block itself is what changes the pods' phase env and rolls."""
+    base = {
+        "task": serve.spec.task,
+        "checkpoint": serve.spec.checkpoint,
+        "template": serde.to_wire(serve.spec.template),
+        "batching": serde.to_wire(serve.spec.batching),
+    }
+    if serve.spec.disaggregation is not None:
+        base["disaggregation"] = True
+    return template_hash(base)
 
 
-def render_serve_pod(serve: TPUServe, version: str, index: int) -> Pod:
+def serve_pools(serve: TPUServe) -> List[Tuple[str, int]]:
+    """The serve's replica pools as ``(phase, desired_count)`` pairs:
+    one anonymous pool for a single-pool serve, the labeled
+    prefill/decode pair under disaggregation."""
+    d = serve.spec.disaggregation
+    if d is None:
+        return [("", serve.spec.replicas)]
+    return [("prefill", d.prefill_replicas), ("decode", d.decode_replicas)]
+
+
+def pod_phase_of(pod: Pod) -> str:
+    """Which pool a serving pod belongs to ("" = the single pool)."""
+    return pod.metadata.labels.get(L.SERVE_PHASE, "")
+
+
+def render_serve_pod(
+    serve: TPUServe, version: str, index: int, phase: str = ""
+) -> Pod:
     """One serving replica pod at ``version``. Names carry the version so
-    surge pods of two template generations coexist during a rollout."""
+    surge pods of two template generations coexist during a rollout;
+    disaggregated pods also carry their ``phase`` (name, label, and
+    ``TFK8S_SERVE_PHASE`` env) so the two pools render, roll, and
+    aggregate independently."""
     spec = serve.spec
-    name = f"{serve.metadata.name}-srv-{version}-{index}"
+    tag = f"{phase}-" if phase else ""
+    name = f"{serve.metadata.name}-srv-{version}-{tag}{index}"
     tmpl = spec.template
     env = {
         **tmpl.env,
@@ -123,8 +154,12 @@ def render_serve_pod(serve: TPUServe, version: str, index: int) -> Pod:
         "TFK8S_SERVE_PAGE_SIZE": str(spec.batching.page_size),
         "TFK8S_SERVE_MAX_PAGES": str(spec.batching.max_pages),
     }
+    if phase:
+        env["TFK8S_SERVE_PHASE"] = phase
     lbls = L.serve_version_labels(serve.metadata.name, version)
     lbls[L.REPLICA_INDEX] = str(index)
+    if phase:
+        lbls[L.SERVE_PHASE] = phase
     return Pod(
         metadata=ObjectMeta(
             name=name,
@@ -225,6 +260,8 @@ class TPUServeController:
              "Serving pods created by the reconciler."),
             ("tfk8s_serving_pods_deleted_total",
              "Serving pods deleted by the reconciler."),
+            ("tfk8s_serving_pool_ready_replicas",
+             "Ready replicas per disaggregated phase pool."),
         ):
             self.metrics.describe(mname, help_text)
         # key -> (ema_queue_depth, ema_qps)
@@ -325,7 +362,12 @@ class TPUServeController:
         ready_new = [p for p in new if pod_is_ready(p)]
         ready_old = [p for p in old if pod_is_ready(p)]
 
-        replicas = serve.spec.replicas
+        # Desired state is a set of pools: one anonymous pool normally,
+        # the prefill/decode pair under disaggregation. Surge ceiling and
+        # availability floor are computed over the TOTAL so a serve
+        # transitioning single<->disagg still honors the rollout contract.
+        pools = serve_pools(serve)
+        replicas = sum(count for _, count in pools)
         ru = serve.spec.rolling_update
         floor = max(replicas - ru.max_unavailable, 0)
         ceiling = replicas + ru.max_surge
@@ -342,26 +384,30 @@ class TPUServeController:
             )
 
         # -- surge creation: bring up new-version replicas, bounded by the
-        #    ceiling; indices not present among new pods are missing
-        have_idx = {
-            int(p.metadata.labels.get(L.REPLICA_INDEX, "-1")) for p in new
-        }
+        #    ceiling; per pool, indices not present among that pool's new
+        #    pods are missing (indices are pool-local: prefill-0 and
+        #    decode-0 coexist)
         to_create: List[Pod] = []
-        for i in range(replicas):
-            if i in have_idx:
-                continue
-            if len(live) + len(to_create) >= ceiling:
-                break
-            pod = render_serve_pod(serve, version, i)
-            with self.tracer.start_span(
-                "pod.create", attributes={"pod": pod.metadata.key}
-            ) as sp:
-                # same control->data plane handoff as the trainer: the
-                # replica's kubelet/entrypoint spans continue THIS trace,
-                # so a rollout reads as one tree from CRD edit to Ready
-                if sp.traceparent and pod.spec.containers:
-                    pod.spec.containers[0].env[TRACEPARENT_ENV] = sp.traceparent
-            to_create.append(pod)
+        for phase, count in pools:
+            have_idx = {
+                int(p.metadata.labels.get(L.REPLICA_INDEX, "-1"))
+                for p in new if pod_phase_of(p) == phase
+            }
+            for i in range(count):
+                if i in have_idx:
+                    continue
+                if len(live) + len(to_create) >= ceiling:
+                    break
+                pod = render_serve_pod(serve, version, i, phase=phase)
+                with self.tracer.start_span(
+                    "pod.create", attributes={"pod": pod.metadata.key}
+                ) as sp:
+                    # same control->data plane handoff as the trainer: the
+                    # replica's kubelet/entrypoint spans continue THIS trace,
+                    # so a rollout reads as one tree from CRD edit to Ready
+                    if sp.traceparent and pod.spec.containers:
+                        pod.spec.containers[0].env[TRACEPARENT_ENV] = sp.traceparent
+                to_create.append(pod)
         if to_create:
             created = self.cs.pods(ns).create_many(to_create)
             if created:
@@ -392,9 +438,11 @@ class TPUServeController:
         #    scale-down while a retained pod is still loading must not
         #    take the last serving replicas with it (the retained pod's
         #    readiness unblocks the rest, level-triggered).
+        desired_by_phase = dict(pools)
         extra = sorted(
             (p for p in new
-             if int(p.metadata.labels.get(L.REPLICA_INDEX, "-1")) >= replicas),
+             if int(p.metadata.labels.get(L.REPLICA_INDEX, "-1"))
+             >= desired_by_phase.get(pod_phase_of(p), 0)),
             key=lambda p: (pod_is_ready(p),
                            -int(p.metadata.labels.get(L.REPLICA_INDEX, "-1"))),
         )
@@ -432,6 +480,14 @@ class TPUServeController:
         self.metrics.set_gauge(
             "tfk8s_serving_desired_replicas", float(replicas), serve_labels
         )
+        if serve.spec.disaggregation is not None:
+            for phase, _count in pools:
+                self.metrics.set_gauge(
+                    "tfk8s_serving_pool_ready_replicas",
+                    float(sum(1 for p in ready_new + ready_old
+                              if pod_phase_of(p) == phase)),
+                    {**serve_labels, "phase": phase},
+                )
 
         # keep the loop live: readiness flips and load reports arrive via
         # pod updates, but a quiet system (or an autoscaler waiting out
@@ -463,6 +519,10 @@ class TPUServeController:
             "tfk8s_serving_smoothed_queue_depth", ema_depth,
             {"namespace": serve.metadata.namespace, "serve": serve.metadata.name},
         )
+
+        if serve.spec.disaggregation is not None:
+            self._autoscale_pools(serve, ready_pods)
+            return
 
         n = serve.spec.replicas
         if not ready_pods or n < 1:
@@ -502,6 +562,96 @@ class TPUServeController:
         log.info("%s: autoscale %s %d -> %d (ema depth %.2f)",
                  key, direction, n, want, ema_depth)
 
+    def _autoscale_pools(self, serve: TPUServe, ready_pods: List[Pod]) -> None:
+        """Disaggregated autoscaling: each phase pool sizes off ITS OWN
+        signal. Prefill replicas absorb queue wait, so the prefill pool
+        runs the standard queue-depth law over prefill pods only; decode
+        replicas hold long-lived slots, so the decode pool targets slot
+        occupancy (live decode slots vs. slot capacity). One spec patch
+        carries both counts (a partial patch could clobber the sibling
+        pool on a merge that replaces the nested object)."""
+        auto = serve.spec.autoscale
+        d = serve.spec.disaggregation
+        key = serve.metadata.key
+
+        def _ema(tag: str, inst: float) -> float:
+            prev, _ = self._load_ema.get(f"{key}#{tag}", (inst, 0.0))
+            val = EMA_ALPHA * inst + (1 - EMA_ALPHA) * prev
+            self._load_ema[f"{key}#{tag}"] = (val, 0.0)
+            return val
+
+        prefill = [p for p in ready_pods if pod_phase_of(p) == "prefill"]
+        decode = [p for p in ready_pods if pod_phase_of(p) == "decode"]
+
+        # prefill: queue depth per ready prefill replica (same law as the
+        # single-pool autoscaler, scoped to the pool)
+        pq = _ema("prefill", sum(
+            p.status.training.get("serving_queue_depth", 0.0) for p in prefill
+        ))
+        want_p = n_p = d.prefill_replicas
+        if prefill and n_p >= 1:
+            per = pq / len(prefill)
+            if per > auto.target_queue_depth * auto.high_band:
+                want_p = min(
+                    max(n_p + 1, math.ceil(pq / auto.target_queue_depth)),
+                    auto.max_replicas,
+                )
+            elif per < auto.target_queue_depth * auto.low_band:
+                want_p = max(n_p - 1, auto.min_replicas)
+
+        # decode: slot occupancy — live decode slots over the pool's slot
+        # capacity (ready replicas x max_batch_size)
+        slots = _ema("decode", sum(
+            p.status.training.get("serving_live_slots", 0.0) for p in decode
+        ))
+        cap_per = max(serve.spec.batching.max_batch_size, 1)
+        want_d = n_d = d.decode_replicas
+        if decode and n_d >= 1:
+            occ = slots / (len(decode) * cap_per)
+            if occ > DECODE_TARGET_OCCUPANCY * auto.high_band:
+                want_d = min(
+                    max(n_d + 1,
+                        math.ceil(slots / (DECODE_TARGET_OCCUPANCY * cap_per))),
+                    auto.max_replicas,
+                )
+            elif occ < DECODE_TARGET_OCCUPANCY * auto.low_band:
+                want_d = max(n_d - 1, auto.min_replicas)
+
+        if want_p == n_p and want_d == n_d:
+            return
+        now = time.monotonic()
+        if now - self._last_scale.get(key, -1e9) < auto.cooldown_s:
+            return  # cooldown: the anti-flap guarantee
+        try:
+            self.cs.tpuserves(serve.metadata.namespace).patch(
+                serve.metadata.name,
+                {"spec": {"disaggregation": {
+                    "prefillReplicas": want_p, "decodeReplicas": want_d,
+                }}},
+            )
+        except (Conflict, NotFound):
+            return  # next periodic pass re-evaluates off fresh state
+        self._last_scale[key] = now
+        d.prefill_replicas, d.decode_replicas = want_p, want_d
+        serve.status.last_scale_time = time.time()
+        for phase, n, want, why in (
+            ("prefill", n_p, want_p, f"ema queue depth {pq:.1f}"),
+            ("decode", n_d, want_d, f"ema live slots {slots:.1f}"),
+        ):
+            if want == n:
+                continue
+            direction = "up" if want > n else "down"
+            self.recorder.event(
+                "TPUServe", key, "Scaled",
+                f"{phase} {direction}: {n} -> {want} ({why})",
+            )
+            self.metrics.inc(
+                "tfk8s_serving_scale_events_total", 1.0,
+                {"direction": direction, "phase": phase},
+            )
+            log.info("%s: autoscale %s pool %s %d -> %d",
+                     key, phase, direction, n, want)
+
     # ----------------------------------------------------------- status
 
     def _update_status(
@@ -517,14 +667,28 @@ class TPUServeController:
         st.replicas = len(live)
         st.ready_replicas = len(ready_new) + len(ready_old)
         st.updated_replicas = len(new)
-        st.endpoint = (
-            f"/v1/serve/{serve.metadata.namespace}/{serve.metadata.name}"
-        )
-        rollout_done = len(new) == len(live) and len(ready_new) >= serve.spec.replicas
+        base = f"/v1/serve/{serve.metadata.namespace}/{serve.metadata.name}"
+        pools = serve_pools(serve)
+        if serve.spec.disaggregation is None:
+            st.endpoint = base
+        else:
+            # both phase pools are published; the gateway serves the bare
+            # path and splits prefill/decode internally
+            st.endpoint = ",".join(f"{base}#{phase}" for phase, _ in pools)
+        replicas = sum(count for _, count in pools)
+        rollout_done = len(new) == len(live) and len(ready_new) >= replicas
         if rollout_done:
             st.observed_version = version
-        replicas = serve.spec.replicas
-        available = st.ready_replicas >= replicas and replicas > 0
+        if serve.spec.disaggregation is None:
+            available = st.ready_replicas >= replicas and replicas > 0
+        else:
+            # a disaggregated serve needs BOTH pools at strength: a fully
+            # ready prefill pool can't cover for an empty decode pool
+            ready = ready_new + ready_old
+            available = replicas > 0 and all(
+                sum(1 for p in ready if pod_phase_of(p) == phase) >= count
+                for phase, count in pools
+            )
         set_serve_condition(
             st, ServeConditionType.AVAILABLE,
             available,

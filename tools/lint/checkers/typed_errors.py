@@ -32,7 +32,9 @@ SCOPE = (
     "tfk8s_tpu/runtime/server.py",
     "tfk8s_tpu/runtime/registry.py",
     "tfk8s_tpu/runtime/paging.py",
+    "tfk8s_tpu/runtime/handoff.py",
     "tfk8s_tpu/gateway/server.py",
+    "tfk8s_tpu/gateway/affinity.py",
     "tfk8s_tpu/gateway/router.py",
     "tfk8s_tpu/gateway/admission.py",
     "tfk8s_tpu/gateway/client.py",
@@ -42,6 +44,9 @@ SCOPE = (
 SEED_ROOTS = {
     "StoreError", "ServeError", "ValidationError", "FrozenObjectError",
     "PodDrained", "OutOfPages", "TopologyError", "_AdmissionRejected",
+    # the KV handoff plane's typed wire error (runtime/handoff.py): a
+    # standalone root — deriving from ServeError would cycle the import
+    "HandoffError",
 }
 # contract violations by the CALLER'S programmer, not wire errors
 CONTRACT_ERRORS = {"NotImplementedError", "AssertionError", "StopIteration"}
