@@ -1329,6 +1329,342 @@ def _gen_serving_probe(small: bool, full: bool = False):
     }
 
 
+def _sched_probe(small: bool, full: bool = False):
+    """Token scheduler (ISSUE 15), two claims:
+
+    A) PRIORITY + PREEMPTION: the SAME mixed-priority open-loop workload
+    (a flood of priority-0 bulk requests with a sparse stream of
+    priority-2 interactive ones) through a FIFO decode loop and a
+    priority loop, both on a page pool deliberately too small for every
+    bulk row to stay resident. Under FIFO the interactive requests queue
+    behind the flood; under the priority scheduler they jump the queue
+    and — when their prefill stalls on pages — spill the youngest bulk
+    row's KV to the host buffer (``tfk8s_sched_preemptions_total``).
+    Reported: per-class p99 TPOT (end-to-end latency / generated tokens,
+    queue wait included — that IS the product metric) for both arms,
+    preemption count, and the priority arm's aggregate useful tokens/s
+    (the scheduler must not buy latency with throughput: in full mode
+    this is compared against the recorded ISSUE-7 continuous-batching
+    floor, same model scale and slot count).
+
+    B) SPECULATIVE DECODE: a tiny DRAFT and a mid-shaped TARGET are both
+    briefly trained on the hermetic affine-chain stream (the draft is
+    ~16x cheaper per step but learns the same transition table, so its
+    greedy proposals genuinely match the target's picks), then the same
+    chain-prompt workload runs through a plain loop and a speculative
+    loop (k draft proposals verified in ONE packed target step).
+    Reported: tokens/s both arms, the speedup, the realized accept
+    ratio, and a token-identity bit (speculative output must equal plain
+    output stream-for-stream — draft quality only sets the speedup)."""
+    import dataclasses as _dc
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import numpy as np
+
+    from tfk8s_tpu.models import gpt
+    from tfk8s_tpu.models.bert import make_chain_tokens
+    from tfk8s_tpu.parallel.mesh import make_mesh
+    from tfk8s_tpu.runtime.sched import SpeculativeEngine
+    from tfk8s_tpu.runtime.server import DecodeLoopExecutor, PagedGptDecoder
+    from tfk8s_tpu.runtime.train import TrainConfig, Trainer
+    from tfk8s_tpu.utils.logging import Metrics
+
+    small_mode = small and not full
+    # aging deliberately long for the measurement window: anti-starvation
+    # promotion is a liveness guarantee, not a latency feature, and a
+    # seconds-scale bench with product aging (5s) would promote the bulk
+    # flood mid-run and blur the very separation being measured
+    aging_s = 30.0
+    if small_mode:
+        # bulk budgets sized so ~2 resident rows fill the 15 usable
+        # pages AND the service rate sits below the 1k req/s arrival —
+        # without saturation there is no queue and nothing to schedule
+        size, vocab = "tiny", 64
+        slots, page_size, max_pages, chunk = 4, 8, 16, 16
+        n_requests, hi_every = 64, 4
+        lo_prompt_lens, gen_lo, gen_hi = (8, 12, 16), 24, 40
+        # an interactive request with a real prompt: 4 pages of need, so
+        # a packed pool (free < 4) actually stalls it into a preemption
+        hi_prompt, hi_gen = 24, 4
+    else:
+        # the ISSUE-7 model scale and page budget (gpt-mid, 192 pages),
+        # but 16 slots and long bulk budgets so the PAGE POOL is the
+        # binding resource rather than slots — 16 resident max-budget
+        # rows would need 256 pages.  The floor ratio stays honest:
+        # same model, same page budget, strictly more slots.
+        size, vocab = "mid", 256
+        slots, page_size, max_pages, chunk = 16, 16, 192, 64
+        n_requests, hi_every = 96, 6
+        lo_prompt_lens = tuple(range(64, 194, 6))
+        gen_lo, gen_hi = 32, 64
+        hi_prompt, hi_gen = 64, 8
+    HI = 2
+    rng = np.random.default_rng(11)
+    workload = []
+    for i in range(n_requests):
+        if i % hi_every == hi_every - 1:
+            pl, gen, pri = hi_prompt, hi_gen, HI
+        else:
+            pl = int(rng.choice(lo_prompt_lens))
+            gen, pri = int(rng.integers(gen_lo, gen_hi + 1)), 0
+        workload.append((
+            {
+                "tokens": rng.integers(1, vocab, size=pl).astype(np.int32),
+                "gen_tokens": gen,
+            },
+            pri,
+        ))
+    useful = sum(p["gen_tokens"] for p, _ in workload)
+    interval = 0.001
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return round(xs[min(int(len(xs) * q), len(xs) - 1)] * 1000, 3)
+
+    hi_need = -(-(hi_prompt + hi_gen) // page_size)
+
+    def warm_spill(loop):
+        """Compile-warm the preemption machinery (KV export on spill,
+        chunked re-prefill on restore) before the clock starts: full-slot
+        bulk rows plus small fillers pack the pool until a high-priority
+        arrival cannot admit, forcing one spill. Best-effort — if the
+        fillers retire before the pool packs, the first timed preemption
+        pays the compile instead."""
+        with ThreadPoolExecutor(max_workers=slots + 2) as wpool:
+            big = dec.pages_per_slot * page_size
+            n_big = min((max_pages - 1) // dec.pages_per_slot, slots - 2)
+            bulk = [
+                wpool.submit(
+                    loop.submit,
+                    {"tokens": rng.integers(
+                        1, vocab, size=big - 32).astype(np.int32),
+                     "gen_tokens": 32},
+                    600,
+                )
+                for _ in range(n_big)
+            ]
+            bulk += [
+                wpool.submit(
+                    loop.submit,
+                    {"tokens": rng.integers(1, vocab, size=8).astype(
+                        np.int32),
+                     "gen_tokens": 3 * page_size},
+                    600,
+                )
+                for _ in range(3)
+            ]
+            deadline = time.perf_counter() + 5.0
+            while (loop.allocator.available() >= hi_need
+                   and time.perf_counter() < deadline):
+                time.sleep(0.002)
+            loop.submit(
+                {"tokens": np.ones(hi_prompt, np.int32),
+                 "gen_tokens": hi_gen},
+                timeout=600, priority=HI,
+            )
+            for b in bulk:
+                b.result()
+
+    def run_pri_arm(loop):
+        # warm the prefill/decode programs through THIS loop before the
+        # clock starts (the decoder is shared across arms, so only the
+        # first arm actually compiles)
+        loop.submit({"tokens": workload[0][0]["tokens"], "gen_tokens": 2},
+                    timeout=600)
+        per_class = {0: [], HI: []}
+        with ThreadPoolExecutor(max_workers=64) as pool:
+            t_start = time.perf_counter()
+            futs = []
+            for i, (payload, pri) in enumerate(workload):
+                target = t_start + i * interval
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+
+                def one(payload=payload, pri=pri):
+                    t0 = time.perf_counter()
+                    out = loop.submit(payload, timeout=600, priority=pri)
+                    return pri, time.perf_counter() - t0, len(out["tokens"])
+
+                futs.append(pool.submit(one))
+            for f in futs:
+                pri, lat, ntok = f.result()
+                per_class[pri].append(lat / max(ntok, 1))
+            elapsed = time.perf_counter() - t_start
+        return {
+            "hi_p99": pctl(per_class[HI], 0.99),
+            "lo_p99": pctl(per_class[0], 0.99),
+            "tokens_per_s": round(useful / elapsed, 1),
+        }
+
+    dec = PagedGptDecoder(
+        "seed:0", slots=slots, page_size=page_size, max_pages=max_pages,
+        gen_tokens=gen_hi, size=size, prefill_chunk=chunk,
+    )
+    dec.load()
+    fifo_loop = DecodeLoopExecutor(
+        dec, queue_limit=n_requests * 2, metrics=Metrics()
+    ).start()
+    try:
+        fifo = run_pri_arm(fifo_loop)
+    finally:
+        fifo_loop.drain(timeout=30)
+    pri_loop = DecodeLoopExecutor(
+        dec, queue_limit=n_requests * 2, metrics=Metrics(),
+        sched_policy="priority", preemption=True, aging_s=aging_s,
+    ).start()
+    try:
+        warm_spill(pri_loop)
+        warm_preemptions = pri_loop.preempted_total
+        pri = run_pri_arm(pri_loop)
+        preemptions = pri_loop.preempted_total - warm_preemptions
+    finally:
+        pri_loop.drain(timeout=30)
+
+    floor = None
+    if not small_mode:
+        # the ISSUE-7 continuous-batching artifact is the committed
+        # throughput floor at this model scale; absent (fresh checkout
+        # pruned of artifacts) the ratio is simply not reported
+        fp = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DETAIL_issue7_continuous_batching.json",
+        )
+        try:
+            with open(fp) as f:
+                floor = json.load(f)["gen_serving"]["gen_tokens_per_s"]
+        except (OSError, KeyError, ValueError):
+            floor = None
+
+    # -- speculative half --------------------------------------------------
+    mesh = make_mesh(data=jax.device_count())
+    if small_mode:
+        # mid shape at test vocab: heavy enough that a target step costs
+        # real FLOPs next to the tiny draft, shallow enough to learn the
+        # chain in ~100 steps. Training runs at seq 48 — the workload
+        # never passes position 47, and the shorter sequence keeps the
+        # train bill down.
+        tgt_cfg = gpt.mid_config(vocab_size=64, max_len=64)
+        seq_len, steps, tbatch = 48, 120, 8
+        s_slots, s_ps, s_mp, s_chunk = 4, 8, 48, 16
+        s_n, s_pl, s_gen = 16, 24, 24
+    else:
+        tgt_cfg = gpt.mid_config()
+        # train seq 128 < max_len 256: positions past the trained range
+        # have garbage embeddings, so the workload stays under 128
+        seq_len, steps, tbatch = 128, 200, 16
+        s_slots, s_ps, s_mp, s_chunk = 8, 16, 192, 64
+        s_n, s_pl, s_gen = 32, 64, 48
+    draft_cfg = _dc.replace(
+        gpt.tiny_config(),
+        vocab_size=tgt_cfg.vocab_size, max_len=tgt_cfg.max_len,
+    )
+    t_train0 = time.perf_counter()
+
+    def train(cfg, lr=3e-3):
+        task = gpt.make_task(cfg=cfg, seq_len=seq_len, batch_size=tbatch)
+        trainer = Trainer(
+            task,
+            TrainConfig(steps=steps, learning_rate=lr, log_every=10 ** 6),
+            mesh,
+        )
+        state, history = trainer.fit()
+        return state.params, round(
+            float(history[-1]["next_token_accuracy"]), 3
+        )
+
+    tgt_params, tgt_acc = train(tgt_cfg)
+    draft_params, draft_acc = train(draft_cfg)
+    train_s = round(time.perf_counter() - t_train0, 1)
+
+    sdec = PagedGptDecoder(
+        "trained:sched-target", slots=s_slots, page_size=s_ps,
+        max_pages=s_mp, gen_tokens=s_gen, size=size, prefill_chunk=s_chunk,
+        cfg=tgt_cfg, params=tgt_params,
+    )
+    sdec.load()
+    rows = make_chain_tokens(rng, s_n, s_pl, tgt_cfg.vocab_size)
+    spec_workload = [
+        {"tokens": rows[i].astype(np.int32), "gen_tokens": s_gen}
+        for i in range(s_n)
+    ]
+
+    def run_spec_arm(loop):
+        loop.submit({"tokens": rows[0].astype(np.int32), "gen_tokens": 2},
+                    timeout=600)
+        outs = [None] * s_n
+        with ThreadPoolExecutor(max_workers=max(s_n, 8)) as pool:
+            t0 = time.perf_counter()
+            futs = [
+                pool.submit(
+                    lambda i=i, r=r: outs.__setitem__(
+                        i, list(loop.submit(r, timeout=600)["tokens"])
+                    )
+                )
+                for i, r in enumerate(spec_workload)
+            ]
+            for f in futs:
+                f.result()
+            elapsed = time.perf_counter() - t0
+        return outs, elapsed
+
+    plain_loop = DecodeLoopExecutor(
+        sdec, queue_limit=s_n * 2, metrics=Metrics()
+    ).start()
+    try:
+        plain_out, plain_s = run_spec_arm(plain_loop)
+    finally:
+        plain_loop.drain(timeout=30)
+    engine = SpeculativeEngine.build(sdec, k=4, size="tiny",
+                                     params=draft_params)
+    spec_loop = DecodeLoopExecutor(
+        sdec, queue_limit=s_n * 2, metrics=Metrics(), speculative=engine,
+    ).start()
+    try:
+        spec_out, spec_s = run_spec_arm(spec_loop)
+    finally:
+        spec_loop.drain(timeout=30)
+    spec_useful = s_n * s_gen
+    plain_tps = round(spec_useful / plain_s, 1)
+    spec_tps = round(spec_useful / spec_s, 1)
+
+    return {
+        "sched_model": f"gpt-{size}",
+        "sched_requests": n_requests,
+        "sched_hi_requests": n_requests // hi_every,
+        "sched_aging_s": aging_s,
+        "sched_max_pages": max_pages,
+        "sched_hi_tpot_p99_ms": pri["hi_p99"],
+        "sched_hi_tpot_p99_ms_fifo": fifo["hi_p99"],
+        "sched_hi_p99_win": (
+            round(fifo["hi_p99"] / pri["hi_p99"], 2) if pri["hi_p99"] else None
+        ),
+        "sched_lo_tpot_p99_ms": pri["lo_p99"],
+        "sched_lo_tpot_p99_ms_fifo": fifo["lo_p99"],
+        "sched_preemptions": preemptions,
+        "sched_tokens_per_s": pri["tokens_per_s"],
+        "sched_tokens_per_s_fifo": fifo["tokens_per_s"],
+        "sched_vs_issue7_floor": (
+            round(pri["tokens_per_s"] / floor, 3) if floor else None
+        ),
+        "sched_spec_target": f"gpt-mid(v{tgt_cfg.vocab_size})",
+        "sched_spec_draft": f"gpt-tiny(v{tgt_cfg.vocab_size})",
+        "sched_spec_k": 4,
+        "sched_spec_requests": s_n,
+        "sched_plain_tokens_per_s": plain_tps,
+        "sched_spec_tokens_per_s": spec_tps,
+        "sched_spec_speedup": (
+            round(spec_tps / plain_tps, 2) if plain_tps else None
+        ),
+        "sched_spec_accept_ratio": round(engine.accept_ratio, 3),
+        "sched_spec_identical": bool(plain_out == spec_out),
+        "sched_target_accuracy": tgt_acc,
+        "sched_draft_accuracy": draft_acc,
+        "sched_train_s": train_s,
+    }
+
+
 def _disagg_serving_probe(small: bool, full: bool = False):
     """Disaggregated prefill/decode serving (ISSUE 14), two claims:
 
@@ -2157,6 +2493,19 @@ def main() -> None:
             )
             degraded.append("disagg_serving")
 
+    # -- token scheduler: per-class p99 TPOT under a mixed-priority flood
+    # (priority vs FIFO, page-spill preemption) and speculative decode
+    # tokens/s with a chain-trained draft/target pair (host-side) --------
+    sched_block = None
+    if os.environ.get("BENCH_SCHED", "1") == "1":
+        try:
+            sched_block = _sched_probe(
+                small, full=os.environ.get("BENCH_SCHED_FULL") == "1"
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: sched probe failed: {exc}", file=sys.stderr)
+            degraded.append("sched")
+
     # -- elastic recovery: reclaim-notice -> resized-gang-training time
     # against the real controller + kubelet (hermetic, chip-free) --------
     recovery_block = None
@@ -2378,6 +2727,7 @@ def main() -> None:
                         {"disagg_serving": disagg_block}
                         if disagg_block else {}
                     ),
+                    **({"sched": sched_block} if sched_block else {}),
                     **({"recovery": recovery_block} if recovery_block else {}),
                     **(
                         {
@@ -2444,6 +2794,7 @@ def main() -> None:
         build_headline(
             detail, image_block, detail_name, serving_block, recovery_block,
             gen_serving_block, gateway_block, chaos_block, disagg_block,
+            sched_block,
         )
     )
 
@@ -2458,7 +2809,7 @@ HEADLINE_MAX_CHARS = 1800
 def build_headline(
     detail: dict, image_block, detail_name, serving_block=None,
     recovery_block=None, gen_serving_block=None, gateway_block=None,
-    chaos_block=None, disagg_block=None,
+    chaos_block=None, disagg_block=None, sched_block=None,
 ) -> str:
     """Assemble the final-stdout headline line from the full detail
     record: the fixed key set, the image-decode and serving rows when
@@ -2593,6 +2944,27 @@ def build_headline(
                 if k in disagg_block
             }
         )
+    if sched_block:
+        # the token-scheduler rows ride the headline: the interactive
+        # class's p99 TPOT under the priority scheduler vs FIFO (the
+        # latency claim), the preemption count that bought it, the
+        # priority arm's aggregate tokens/s (the no-throughput-regression
+        # claim), and the speculative speedup + realized accept ratio —
+        # the driver's acceptance keys for the scheduler arm
+        headline_extra.update(
+            {
+                k: sched_block[k]
+                for k in (
+                    "sched_hi_tpot_p99_ms",
+                    "sched_hi_tpot_p99_ms_fifo",
+                    "sched_preemptions",
+                    "sched_tokens_per_s",
+                    "sched_spec_speedup",
+                    "sched_spec_accept_ratio",
+                )
+                if k in sched_block
+            }
+        )
     if recovery_block:
         # the elastic-recovery rows ride the headline: seconds from a
         # reclaim notice to the RESIZED gang's first post-resize optimizer
@@ -2627,6 +2999,7 @@ def build_headline(
         "gateway_trace_overhead",
         "gateway_wire_efficiency", "gateway_p99_ms",
         "chaos_p99_ms", "ejection_time_ms",
+        "sched_hi_tpot_p99_ms_fifo", "sched_preemptions",
         "disagg_tpot_win", "shared_tpot_p99_ms",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
@@ -2635,6 +3008,8 @@ def build_headline(
         "gateway_fairness_ratio", "gateway_qps",
         "chaos_failed_requests",
         "ttft_p99_ms",
+        "sched_spec_accept_ratio", "sched_spec_speedup",
+        "sched_tokens_per_s", "sched_hi_tpot_p99_ms",
         "tpot_p99_ms", "gen_tokens_per_s",
         "disagg_tpot_p99_ms", "affinity_reprefill_saved",
         "recovery_p99_s", "recovery_p50_s",

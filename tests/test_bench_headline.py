@@ -181,11 +181,42 @@ FULL_DISAGG_BLOCK = {
 }
 
 
+FULL_SCHED_BLOCK = {
+    "sched_model": "gpt-mid",
+    "sched_requests": 96,
+    "sched_hi_requests": 16,
+    "sched_aging_s": 30.0,
+    "sched_max_pages": 96,
+    "sched_hi_tpot_p99_ms": 74.2,
+    "sched_hi_tpot_p99_ms_fifo": 411.8,
+    "sched_hi_p99_win": 5.55,
+    "sched_lo_tpot_p99_ms": 512.3,
+    "sched_lo_tpot_p99_ms_fifo": 488.0,
+    "sched_preemptions": 7,
+    "sched_tokens_per_s": 861.4,
+    "sched_tokens_per_s_fifo": 893.2,
+    "sched_vs_issue7_floor": 0.952,
+    "sched_spec_target": "gpt-mid(v256)",
+    "sched_spec_draft": "gpt-tiny(v256)",
+    "sched_spec_k": 4,
+    "sched_spec_requests": 32,
+    "sched_plain_tokens_per_s": 612.0,
+    "sched_spec_tokens_per_s": 918.0,
+    "sched_spec_speedup": 1.5,
+    "sched_spec_accept_ratio": 0.83,
+    "sched_spec_identical": True,
+    "sched_target_accuracy": 0.871,
+    "sched_draft_accuracy": 0.842,
+    "sched_train_s": 41.2,
+}
+
+
 def test_headline_is_one_json_line_under_the_ceiling():
     line = bench.build_headline(
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
         FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
         FULL_GATEWAY_BLOCK, FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK,
+        FULL_SCHED_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -243,6 +274,20 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert "disagg_handoffs" not in parsed["extra"]
     assert "scatter_prefilled_tokens" not in parsed["extra"]
     assert "disagg_handoff_bytes_mean" not in parsed["extra"]
+    # ISSUE-15 token-scheduler acceptance keys: interactive p99 TPOT
+    # under priority vs FIFO, the preemptions that bought it, aggregate
+    # tokens/s, and the speculative speedup + realized accept ratio
+    assert parsed["extra"]["sched_hi_tpot_p99_ms"] == 74.2
+    assert parsed["extra"]["sched_hi_tpot_p99_ms_fifo"] == 411.8
+    assert parsed["extra"]["sched_preemptions"] == 7
+    assert parsed["extra"]["sched_tokens_per_s"] == 861.4
+    assert parsed["extra"]["sched_spec_speedup"] == 1.5
+    assert parsed["extra"]["sched_spec_accept_ratio"] == 0.83
+    # ...the training/workload provenance stays in the detail record
+    assert "sched_train_s" not in parsed["extra"]
+    assert "sched_spec_identical" not in parsed["extra"]
+    assert "sched_lo_tpot_p99_ms" not in parsed["extra"]
+    assert "sched_vs_issue7_floor" not in parsed["extra"]
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -253,7 +298,7 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
     line = bench.build_headline(
         _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
         FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK, FULL_GATEWAY_BLOCK,
-        FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK,
+        FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK, FULL_SCHED_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -273,6 +318,7 @@ def test_headline_without_image_block():
     assert "gateway_qps" not in parsed["extra"]
     assert "chaos_failed_requests" not in parsed["extra"]
     assert "affinity_reprefill_saved" not in parsed["extra"]
+    assert "sched_hi_tpot_p99_ms" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
@@ -295,5 +341,8 @@ def test_serving_keys_in_drop_order():
                 "chaos_failed_requests", "chaos_p99_ms",
                 "ejection_time_ms",
                 "affinity_reprefill_saved", "disagg_tpot_p99_ms",
-                "shared_tpot_p99_ms", "disagg_tpot_win"):
+                "shared_tpot_p99_ms", "disagg_tpot_win",
+                "sched_hi_tpot_p99_ms", "sched_hi_tpot_p99_ms_fifo",
+                "sched_preemptions", "sched_tokens_per_s",
+                "sched_spec_speedup", "sched_spec_accept_ratio"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

@@ -88,6 +88,12 @@ def test_default_scope_covers_hotpath_counters():
         "tfk8s_disagg_handoff_bytes": False,
         "tfk8s_gateway_affinity_requests_total": False,
         "tfk8s_gateway_affinity_ring_members": False,
+        # ISSUE-15 token-scheduler series: the sched bench arm and the
+        # priority/preemption/speculative tests key off these exact names
+        "tfk8s_sched_preemptions_total": False,
+        "tfk8s_sched_restores_total": False,
+        "tfk8s_sched_queue_depth": False,
+        "tfk8s_sched_spec_accept_ratio": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

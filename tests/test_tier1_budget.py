@@ -75,6 +75,11 @@ HEAVY = [
     # in the same module gate the handoff/affinity machinery fast
     ("test_disagg_serving.py",
      "TestDisaggE2E.test_disagg_serve_e2e_with_sticky_session"),
+    # ISSUE 15: redundant flavors — the greedy single-preemption and
+    # speculative token-identity gates below cover the same machinery
+    ("test_sched.py",
+     "TestPreemption.test_sampled_victim_resumes_its_exact_stream"),
+    ("test_sched.py", "TestSpeculative.test_spec_respects_eos_and_budget"),
 ]
 
 # The fast representative that keeps each subsystem gated in tier-1.
@@ -111,6 +116,13 @@ FAST_GATES = [
     # bit-identical KV handoff must stay gated in tier-1
     ("test_disagg_serving.py",
      "TestDisaggGateway.test_two_phase_roundtrip_is_bit_identical_and_sets_session"),
+    # ISSUE 15 token scheduler: packed per-row sampling equivalence, the
+    # deterministic page-pressure preemption with a bit-identical resume,
+    # and speculative decode's token-identity must stay gated in tier-1
+    ("test_sched.py",
+     "TestPackedSampling.test_sampled_stream_is_bit_identical_to_generate"),
+    ("test_sched.py", "TestPreemption.test_single_preemption_is_bit_identical"),
+    ("test_sched.py", "TestSpeculative.test_speculative_is_token_identical"),
 ]
 
 

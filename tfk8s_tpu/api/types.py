@@ -335,6 +335,88 @@ class ServeCondition:
 
 
 @dataclass
+class SamplingParams:
+    """Per-request token sampling knobs (models/gpt.filter_logits
+    semantics, threaded per-row through the packed decode step).
+    ``temperature`` 0 means greedy — bit-identical to the argmax path;
+    ``top_k`` 0 and ``top_p`` 1.0 disable their cuts. ``seed`` plus the
+    absolute-position PRNG fold make a sampled stream deterministic
+    under resume (preemption spill/restore, KV handoff).
+
+    This dataclass IS the wire contract for a request's ``sampling``
+    block: runtime/server's request parsing normalizes through
+    :meth:`from_payload`, so the defaults and ranges here are what the
+    serving path enforces."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    @classmethod
+    def from_payload(cls, raw: Any) -> "SamplingParams":
+        """Normalize a request payload's ``sampling`` block. Accepts both
+        wire casings (``topK``/``top_k``) since gateway payloads arrive
+        camelCase while tests speak snake_case. Raises ``ValueError`` on
+        malformed blocks and out-of-range knobs — callers on the serving
+        path re-type it as their client-visible InvalidRequest."""
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f"sampling must be a dict, got {type(raw).__name__}"
+            )
+
+        def _get(snake: str, camel: str, default):
+            return raw.get(snake, raw.get(camel, default))
+
+        try:
+            params = cls(
+                temperature=float(_get("temperature", "temperature", 0.0)),
+                top_k=int(_get("top_k", "topK", 0)),
+                top_p=float(_get("top_p", "topP", 1.0)),
+                seed=int(_get("seed", "seed", 0)),
+            )
+        except (TypeError, ValueError):
+            raise ValueError(f"malformed sampling block: {raw!r}") from None
+        if params.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {params.temperature}"
+            )
+        if params.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {params.top_k}")
+        if not 0.0 < params.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {params.top_p}")
+        return params
+
+    def as_tuple(self) -> "tuple":
+        """The (temperature, top_k, top_p, seed) form the decode loop
+        threads through the packed device step."""
+        return (self.temperature, self.top_k, self.top_p, self.seed)
+
+
+@dataclass
+class SchedulerPolicy:
+    """Token-scheduler knobs for the decode loop (runtime/sched).
+    ``policy`` picks admission order: ``fifo`` (arrival order, the
+    default — bit-identical to pre-scheduler behavior) or ``priority``
+    (per-priority-class queues, aged weighted pick; a request gains one
+    priority level per ``aging_s`` seconds queued, the anti-starvation
+    bound). ``preemption`` (priority policy only) lets a stalled
+    higher-priority admission spill a low-priority row's KV pages to a
+    host buffer and requeue it. ``spec_decode`` enables speculative
+    decoding: a ``spec_draft``-sized draft model proposes ``spec_tokens``
+    tokens per row and the serving model verifies them in one packed
+    step — output token-identical to plain decoding, throughput up by
+    the accept ratio."""
+
+    policy: str = "fifo"
+    preemption: bool = True
+    aging_s: float = 5.0
+    spec_decode: bool = False
+    spec_tokens: int = 4
+    spec_draft: str = "tiny"
+
+
+@dataclass
 class BatchingPolicy:
     """Dynamic micro-batching knobs (runtime/server.py): a batch closes at
     ``max_batch_size`` or after ``batch_timeout_ms`` — whichever first —
@@ -356,6 +438,9 @@ class BatchingPolicy:
     # block-paged KV cache (decode loop only; ignored by classifiers)
     page_size: int = 16
     max_pages: int = 256
+    # token scheduler (decode loop only): admission order, preemption,
+    # speculative decode — see SchedulerPolicy
+    scheduler: SchedulerPolicy = field(default_factory=SchedulerPolicy)
 
 
 @dataclass

@@ -217,6 +217,26 @@ def validate_serve(serve: TPUServe) -> List[str]:
             f"spec.batching.maxPages: must be >= 2 (trash page + 1 usable), "
             f"got {b.max_pages}"
         )
+    sch = b.scheduler
+    if sch.policy not in ("fifo", "priority"):
+        errs.append(
+            f"spec.batching.scheduler.policy: must be 'fifo' or 'priority', "
+            f"got {sch.policy!r}"
+        )
+    if sch.aging_s <= 0:
+        errs.append(
+            f"spec.batching.scheduler.agingS: must be > 0, got {sch.aging_s}"
+        )
+    if sch.spec_tokens < 1:
+        errs.append(
+            f"spec.batching.scheduler.specTokens: must be >= 1, "
+            f"got {sch.spec_tokens}"
+        )
+    if sch.spec_draft not in ("tiny", "mid", "base"):
+        errs.append(
+            f"spec.batching.scheduler.specDraft: must be one of "
+            f"('tiny', 'mid', 'base'), got {sch.spec_draft!r}"
+        )
 
     ru = spec.rolling_update
     if ru.max_surge < 0 or ru.max_unavailable < 0:

@@ -59,6 +59,7 @@ from tfk8s_tpu.runtime.server import (
     Draining,
     InvalidRequest,
     Overloaded,
+    Preempted,
     QuotaExceeded,
     ReplicaUnavailable,
     ServeError,
@@ -131,6 +132,11 @@ def _wire_error(exc: Exception) -> Tuple[int, str, Dict[str, Any], Dict[str, str
         # transport-class: the replica died mid-flight and the retry
         # budget ran out — retriable by the caller, NOT a model failure
         return 503, "Unavailable", _tried_details(exc), headers
+    if isinstance(exc, Preempted):
+        # the row was evicted for a higher-priority admission and its
+        # spill failed — nothing about the request is suspect, the
+        # caller may simply resubmit (503, retriable, like a shed)
+        return 503, "Preempted", {}, headers
     if isinstance(exc, HandoffError):
         # the decode pool refused the prefill pool's KV buffer (version
         # skew mid-rollout, geometry mismatch, integrity failure): a

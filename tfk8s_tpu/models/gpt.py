@@ -196,6 +196,7 @@ def decode_step_packed(
     params,
     pages,
     state: jax.Array,  # [slots, 2 + pages_per_slot] int32
+    sampling: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, Any]:
     """ONE token step for the whole slot batch against the block-paged
     KV cache — every slot's token is embedded at its OWN absolute
@@ -215,12 +216,27 @@ def decode_step_packed(
     measured ~0.25 ms per rebuild on the CPU backend; one packs to
     ~0.1 ms). Returns ``(emitted [slots] int32, new_state, new_pages)``
     with the token/position columns already advanced for the next
-    step."""
+    step.
+
+    ``sampling``, when given, is the packed per-row knob pair
+    ``(samp_f [slots, 2] f32 (temperature, top_p), samp_i [slots, 2]
+    i32 (top_k, seed))`` — rows with ``temperature <= 0`` keep the
+    argmax pick bit-identical to the no-sampling path, sampled rows
+    draw via :func:`sample_tokens` folded at the row's position
+    column (the absolute position of the input token — the
+    :func:`generate` convention, so streams survive resume)."""
     tokens, positions, tables = state[:, 0], state[:, 1], state[:, 2:]
     logits, pages = _paged_apply(
         cfg, params, pages, tokens[:, None], tables, positions
     )
-    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    if sampling is None:
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    else:
+        samp_f, samp_i = sampling
+        nxt = sample_tokens(
+            logits[:, 0], samp_f[:, 0], samp_i[:, 0], samp_f[:, 1],
+            samp_i[:, 1], positions,
+        )
     new_state = state.at[:, 0].set(nxt).at[:, 1].add(1)
     return nxt, new_state, pages
 
@@ -230,20 +246,27 @@ def prefill_step_packed(
     params,
     pages,
     batch: jax.Array,  # [slots, C + 1 + pages_per_slot] int32
+    sampling: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, Any]:
     """Batched chunked prefill: EVERY admitted request's next prompt
     slice rides one ``[slots, C]`` dispatch (rows pack ``C`` chunk
     tokens, the chunk's base position, then the page table; idle rows
     are all-zero — they write into the trash page). One admission burst
     costs one dispatch per chunk ROUND instead of one per request.
-    Returns ``(per-position greedy picks [slots, C] int32, new_pages)``;
+    Returns ``(per-position picks [slots, C] int32, new_pages)``;
     the caller reads a finishing row's pick at its last real prompt
-    position."""
+    position. ``sampling`` is the same per-row knob pair as
+    :func:`decode_step_packed`; column ``j``'s pick folds at
+    ``positions[r] + j`` so a finishing row's first emitted token folds
+    at ``prompt_len - 1`` — bit-identical to :func:`generate`'s first
+    pick for that seed."""
     mpp = cfg.pages_per_slot()
     c = batch.shape[1] - 1 - mpp
     chunk, positions, tables = batch[:, :c], batch[:, c], batch[:, c + 1:]
     logits, pages = _paged_apply(cfg, params, pages, chunk, tables, positions)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), pages
+    if sampling is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), pages
+    return _sample_packed(logits, positions, sampling), pages
 
 
 def prefill_into_slots(
@@ -315,6 +338,130 @@ def filter_logits(
         )[:, None]
         logits = jnp.where(logits < thresh, neg, logits)
     return logits
+
+
+def filter_logits_rows(
+    logits: jax.Array,  # [b, vocab] float
+    top_k: jax.Array,   # [b] int32 — 0 disables the row's top-k cut
+    top_p: jax.Array,   # [b] float32 — 1.0 disables the row's nucleus cut
+) -> jax.Array:
+    """Per-ROW vectorized :func:`filter_logits` for the packed serving
+    step: every row carries its own top-k/top-p, so one dispatch filters
+    a continuous batch of requests with different sampling params. Rows
+    whose knobs are disabled (``top_k == 0`` / ``top_p == 1``) pass
+    through untouched; active rows reproduce ``filter_logits``'s
+    semantics EXACTLY (same single descending sort, same tie-at-the-
+    threshold survival, same exclusive-cumsum nucleus cut — asserted
+    bit-for-bit against per-row ``filter_logits`` calls in
+    tests/test_sched.py)."""
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_active = (top_k > 0)[:, None]
+    kth = jnp.take_along_axis(
+        sorted_desc,
+        (jnp.clip(top_k, 1, vocab) - 1).astype(jnp.int32)[:, None],
+        axis=-1,
+    )
+    logits = jnp.where(k_active & (logits < kth), neg, logits)
+    sorted_desc = jnp.where(k_active & (sorted_desc < kth), neg, sorted_desc)
+    p_active = (top_p < 1.0)[:, None]
+    probs = jax.nn.softmax(sorted_desc.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p[:, None].astype(jnp.float32)
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1
+    )[:, None]
+    return jnp.where(p_active & (logits < thresh), neg, logits)
+
+
+def sample_tokens(
+    logits: jax.Array,       # [n, vocab] fp32 raw logits
+    temperature: jax.Array,  # [n] f32 — <= 0 pins the row to greedy argmax
+    top_k: jax.Array,        # [n] i32
+    top_p: jax.Array,        # [n] f32
+    seeds: jax.Array,        # [n] i32 per-request PRNG seed
+    folds: jax.Array,        # [n] i32 ABSOLUTE position fold index
+) -> jax.Array:
+    """The packed per-row pick: greedy rows (``temperature <= 0``) take
+    ``argmax`` over the RAW logits — bit-identical to the pre-sampling
+    packed step — and sampled rows draw from
+    ``softmax(filter_logits(logits / temperature, top_k, top_p))`` under
+    a key folded from the row's own seed by ABSOLUTE position, the same
+    convention as :func:`generate` (so a request resumed mid-stream —
+    preempt/spill/restore, or a KV handoff — continues the identical
+    sampled stream). Each row's draw uses a ``[1, vocab]`` categorical,
+    matching the key→bits layout of ``generate`` at batch 1."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t_safe = jnp.where(temperature > 0.0, temperature, 1.0)
+    filtered = filter_logits_rows(
+        logits / t_safe[:, None].astype(logits.dtype), top_k, top_p
+    )
+
+    def draw(seed, fold, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), fold)
+        return jax.random.categorical(key, row[None, :], axis=-1)[0]
+
+    drawn = jax.vmap(draw)(seeds, folds, filtered).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, drawn, greedy)
+
+
+def _sample_packed(logits, positions, sampling):
+    """Shared pick for the packed entry points: ``logits`` is
+    ``[slots, C, vocab]``, ``positions`` the per-row base position, and
+    ``sampling = (samp_f [slots, 2] f32 (temperature, top_p),
+    samp_i [slots, 2] i32 (top_k, seed))``. Column ``c`` of row ``r``
+    folds at ``positions[r] + c`` — the absolute position of the token
+    whose logits that column holds."""
+    samp_f, samp_i = sampling
+    slots, c, vocab = logits.shape
+    folds = (positions[:, None] + jnp.arange(c, dtype=positions.dtype))
+    rep = lambda v: jnp.repeat(v, c)
+    picks = sample_tokens(
+        logits.reshape(slots * c, vocab),
+        rep(samp_f[:, 0]), rep(samp_i[:, 0]), rep(samp_f[:, 1]),
+        rep(samp_i[:, 1]), folds.reshape(-1),
+    )
+    return picks.reshape(slots, c)
+
+
+def verify_step_packed(
+    cfg: TransformerConfig,
+    params,
+    pages,
+    state: jax.Array,   # [slots, 2 + pages_per_slot] int32
+    drafts: jax.Array,  # [slots, k] int32 draft-proposed tokens
+    sampling: Optional[Tuple[jax.Array, jax.Array]] = None,
+) -> Tuple[jax.Array, Any]:
+    """Speculative-decode verification: ONE packed chunk forward scores
+    the row's last token plus its ``k`` draft proposals at positions
+    ``P .. P+k`` (``P`` = the state's position column), returning the
+    target model's OWN pick for every one of those positions —
+    ``picks[:, j]`` is the token the target would emit at position
+    ``P+j+1``, computed with exactly the per-row pick (:func:`sample_
+    tokens`, fold ``P+j``) a non-speculative step at that position would
+    use. The caller accepts the longest prefix where
+    ``picks[:, j] == drafts[:, j]`` and appends ``picks[:, a]`` as the
+    correction token — so the emitted stream is token-identical to
+    non-speculative decoding REGARDLESS of draft quality (a bad draft
+    only shrinks the accepted prefix to 0, degenerating to one token per
+    verify step).
+
+    The chunk's K/V scatter writes every proposal's K/V — including
+    rejected ones — but that is safe by the paged-attention overwrite-
+    before-read order: a later step re-scatters the TRUE token's K/V at
+    a stale position before any gather reads it, and the position-
+    visibility mask hides not-yet-reached positions entirely."""
+    tokens, positions, tables = state[:, 0], state[:, 1], state[:, 2:]
+    chunk = jnp.concatenate(
+        [tokens[:, None], drafts.astype(jnp.int32)], axis=1
+    )
+    logits, pages = _paged_apply(cfg, params, pages, chunk, tables, positions)
+    if sampling is None:
+        picks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        picks = _sample_packed(logits, positions, sampling)
+    return picks, pages
 
 
 def prefill_cache(

@@ -224,7 +224,17 @@ class MultiHeadAttention(nn.Module):
             kp = k_pages.value.at[flat].set(k.reshape(-1, h, d))
             vp = v_pages.value.at[flat].set(v.reshape(-1, h, d))
             k_pages.value, v_pages.value = kp, vp
-            # gather each row's pages back as one contiguous-looking view
+            # gather each row's pages back as one contiguous-looking view.
+            # PALLAS SEAM: this dense gather always materializes the FULL
+            # page-table extent — mpp * ps = pages_per_slot() * page_size
+            # tokens per row, filled or not — which is exactly the tile a
+            # fused paged-attention kernel would stream instead. Anything
+            # that reasons about per-row KV footprint (the scheduler's
+            # page-spill math in runtime/server._spill_locked, the
+            # allocator's admission reserve) must use the SAME
+            # pages_per_slot() accounting, or a kernel swap here changes
+            # observable paging behavior (asserted by
+            # tests/test_sched.py::TestPagedGatherSeam).
             rows = (
                 (page_tables * ps)[:, :, None] + jnp.arange(ps)[None, None, :]
             ).reshape(b, mpp * ps)

@@ -190,7 +190,16 @@ class PageAllocator:
         """Reserve the worst-case page budget for ``tokens`` plus
         ``gen_budget`` generated tokens, reusing a cached prefix when one
         matches. Raises :class:`OutOfPages` without side effects when the
-        pool cannot cover it."""
+        pool cannot cover it.
+
+        ``need_pages`` below — ``ceil((len + max(gen, 1)) / page_size)``
+        — is the ONE footprint formula in the system: the attention
+        gather's per-row extent is ``pages_per_slot() = ceil(max_len /
+        page_size)`` of the same shape (the Pallas seam comment in
+        models/transformer.py), and the scheduler's preemption spill
+        (runtime/server._spill_locked) re-admits a spilled row through
+        this exact method with its REMAINING budget, so spill/restore can
+        never free fewer pages than a fresh admission would need."""
         need_pages = -(-(len(tokens) + max(gen_budget, 1)) // self.page_size)
         cached, cached_tokens = self.match_prefix(tokens)
         need_new = need_pages - len(cached)

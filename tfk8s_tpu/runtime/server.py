@@ -147,6 +147,20 @@ class RowFault(RequestFailed):
     quarantines its pages; sibling rows keep decoding untouched."""
 
 
+class Preempted(RequestFailed):
+    """A low-priority row was evicted mid-decode to free KV pages for a
+    stalled higher-priority admission AND its spill could not complete
+    (an export/serialize failure) — the row cannot be resumed, so its
+    request fails typed and retriable. A SUCCESSFUL preemption never
+    surfaces this error: the row's KV spills to a host-side
+    :class:`KVHandoffBuffer`, the request re-enters the queue at the
+    front of its priority class, and it later completes bit-identical to
+    an unpreempted run (the resume path is the KV-handoff import). The
+    class exists so spill failures are distinguishable from
+    :class:`RowFault` (whose pages are suspect) — a preempted-and-lost
+    request's pages were healthy; it is safe to re-dispatch."""
+
+
 # ---------------------------------------------------------------------------
 # Served models
 # ---------------------------------------------------------------------------
@@ -262,6 +276,33 @@ class MlpClassifier(ServedModel):
             )
         out = np.asarray(self._apply(self._params, x))
         return [{"label": int(out[i]), "version": self.version} for i in range(n)]
+
+
+# (temperature, top_k, top_p, seed) — the normalized per-request
+# sampling tuple threaded from validate() through the packed device
+# step. temperature <= 0 pins the row to the greedy argmax path.
+_SamplingTuple = Tuple[float, int, float, int]
+
+
+def _parse_sampling(raw: Any) -> Optional[_SamplingTuple]:
+    """Normalize a payload's ``sampling`` block into ``(temperature,
+    top_k, top_p, seed)`` via :class:`api.types.SamplingParams` — the
+    one wire schema for the block, so defaults/casings/ranges cannot
+    drift between the API surface and this parser. Raises
+    :class:`InvalidRequest` on malformed blocks and out-of-range knobs —
+    the block rides the wire payload, so every failure here is
+    client-visible."""
+    from tfk8s_tpu.api.types import SamplingParams
+
+    if raw is None:
+        return None
+    try:
+        params = SamplingParams.from_payload(raw)
+    except ValueError as e:
+        raise InvalidRequest(str(e)) from None
+    if params.temperature == 0.0:
+        return None  # greedy: identical to no sampling block at all
+    return params.as_tuple()
 
 
 def _gpt_config_of(size: str):
@@ -380,7 +421,8 @@ class PagedGptDecoder:
 
     def __init__(self, checkpoint: str, slots: int, page_size: int,
                  max_pages: int, gen_tokens: int = 16, size: str = "tiny",
-                 prefill_chunk: int = 32, eos_id: Optional[int] = None):
+                 prefill_chunk: int = 32, eos_id: Optional[int] = None,
+                 cfg: Any = None, params: Any = None):
         self.version = checkpoint
         self.slots = max(1, int(slots))
         self.page_size = max(1, int(page_size))
@@ -389,11 +431,23 @@ class PagedGptDecoder:
         self.size = size
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.eos_id = eos_id
+        # explicit base-config / params overrides: the speculative
+        # engine shapes its draft to the target's vocab/max_len, and the
+        # bench injects briefly-trained params so draft acceptance is
+        # genuine — both without touching the checkpoint machinery
+        self._cfg_base = cfg
+        self._params_override = params
         self._params = None
         self._cfg = None
         self._pages = None
         self._decode_fn = None
         self._prefill_fn = None
+        # sampled / speculative-verify variants compile lazily on first
+        # use — a greedy-FIFO replica never pays for them
+        self._decode_samp_fn = None
+        self._prefill_samp_fn = None
+        self._verify_fn = None
+        self._verify_samp_fn = None
 
     def load(self) -> None:
         import dataclasses as _dc
@@ -403,7 +457,10 @@ class PagedGptDecoder:
         from tfk8s_tpu.models import gpt
         from tfk8s_tpu.parallel.sharding import unbox
 
-        base = _gpt_config_of(self.size)
+        base = (
+            self._cfg_base if self._cfg_base is not None
+            else _gpt_config_of(self.size)
+        )
         cfg = _dc.replace(
             base, kv_page_size=self.page_size, kv_max_pages=self.max_pages
         )
@@ -413,7 +470,10 @@ class PagedGptDecoder:
             task = gpt.make_task(cfg=base, seq_len=8, batch_size=1)
             return unbox(task.init(jax.random.key(seed)))
 
-        self._params = _params_from_checkpoint(self.version, init_fn)
+        self._params = (
+            self._params_override if self._params_override is not None
+            else _params_from_checkpoint(self.version, init_fn)
+        )
         self._pages = gpt.clean_pages(cfg)
         # The serving hot path runs the PACKED entry points: greedy pick
         # + position advance fused on device, all per-row step state in
@@ -438,12 +498,40 @@ class PagedGptDecoder:
                 cfg, params, pages, batch
             )
         )
+        # sampled variants thread the per-row knob pair (samp_f =
+        # temperature/top_p f32, samp_i = top_k/seed i32); rows with
+        # temperature 0 stay argmax inside the SAME dispatch, so a mixed
+        # greedy/sampled batch costs one program, and the verify step is
+        # speculative decoding's one-dispatch scoring of k draft tokens
+        self._decode_samp_fn = jax.jit(
+            lambda pages, state, sf, si: gpt.decode_step_packed(
+                cfg, params, pages, state, sampling=(sf, si)
+            )
+        )
+        self._prefill_samp_fn = jax.jit(
+            lambda pages, batch, sf, si: gpt.prefill_step_packed(
+                cfg, params, pages, batch, sampling=(sf, si)
+            )
+        )
+        self._verify_fn = jax.jit(
+            lambda pages, state, drafts: gpt.verify_step_packed(
+                cfg, params, pages, state, drafts
+            )
+        )
+        self._verify_samp_fn = jax.jit(
+            lambda pages, state, drafts, sf, si: gpt.verify_step_packed(
+                cfg, params, pages, state, drafts, sampling=(sf, si)
+            )
+        )
         # KV handoff seam (ISSUE 14): gather/scatter the whole KV tree
         # in ONE XLA program per transfer. The eager per-leaf versions
         # paid a dispatch (and a full pool copy on import) per leaf —
         # measured ~30x slower on the 1-core box, enough to put a
         # handoff import on par with ~15 decode steps of loop stall.
-        # Compiles once per distinct page-count, like prefill chunks.
+        # export_kv/import_kv pad the index to the fixed pages_per_slot
+        # extent (ISSUE 15), so BOTH compile exactly once — preemption
+        # victims carry arbitrary page counts, and a per-count compile
+        # would stall the whole decode loop mid-spill.
         self._export_fn = jax.jit(
             lambda pages, idx: [
                 leaf[idx] for leaf in jax.tree_util.tree_leaves(pages)
@@ -491,14 +579,19 @@ class PagedGptDecoder:
         return self._cfg.vocab_size
 
     def validate(self, payload: Any):
-        """Normalize a payload into ``(tokens int32 [plen], gen_budget)``.
-        Payloads are a 1-D int token array, or a dict ``{"tokens": ...,
-        "gen_tokens": n}`` for a per-request generation budget. Raises
-        TypeError on malformed payloads and :class:`InvalidRequest` on
-        unservable ones (over-long, non-positive budget)."""
+        """Normalize a payload into ``(tokens int32 [plen], gen_budget,
+        sampling)``. Payloads are a 1-D int token array, or a dict
+        ``{"tokens": ..., "gen_tokens": n, "sampling": {...}}`` for a
+        per-request generation budget and sampling knobs
+        (temperature / top_k / top_p / seed — see
+        :func:`_parse_sampling`; ``sampling`` is None for greedy).
+        Raises TypeError on malformed payloads and
+        :class:`InvalidRequest` on unservable ones (over-long,
+        non-positive budget, out-of-range knobs)."""
         import numpy as np
 
         gen = self.gen_tokens
+        sampling = None
         if isinstance(payload, dict):
             if "tokens" not in payload:
                 raise TypeError("gpt payload dict needs a 'tokens' key")
@@ -511,6 +604,7 @@ class PagedGptDecoder:
                     f"gen_tokens must be an int, got "
                     f"{payload.get('gen_tokens')!r}"
                 ) from None
+            sampling = _parse_sampling(payload.get("sampling"))
             payload = payload["tokens"]
         arr = np.asarray(payload)
         if arr.ndim != 1 or arr.dtype.kind not in "iu" or arr.shape[0] < 1:
@@ -525,30 +619,61 @@ class PagedGptDecoder:
                 f"prompt of {arr.shape[0]} + {gen} generated tokens "
                 f"exceeds max_len={self._cfg.max_len}"
             )
-        return arr.astype(np.int32), gen
+        return arr.astype(np.int32), gen, sampling
 
     # -- device dispatch (loop-thread only) ---------------------------------
 
-    def prefill_batch(self, batch):
+    def prefill_batch(self, batch, samp=None):
         """One chunk round for every admitted request: ``batch`` is the
         packed ``[slots, C + 1 + pages_per_slot]`` int32 rows
         (gpt.prefill_step_packed), passed as NUMPY — the jit's internal
         C++ transfer path measured ~3.5x cheaper than an explicit
-        device_put here. Returns the greedy picks ``[slots, C]`` as
-        numpy (synced)."""
+        device_put here. Returns the picks ``[slots, C]`` as numpy
+        (synced). ``samp`` is the per-row ``(samp_f, samp_i)`` knob pair
+        when any admitted row samples; None keeps the original greedy
+        program."""
         import numpy as np
 
-        picks, self._pages = self._prefill_fn(self._pages, batch)
+        if samp is None:
+            picks, self._pages = self._prefill_fn(self._pages, batch)
+        else:
+            picks, self._pages = self._prefill_samp_fn(
+                self._pages, batch, samp[0], samp[1]
+            )
         return np.asarray(picks)
 
-    def decode(self, state):
-        """One fused greedy decode step over the DEVICE-RESIDENT packed
-        state (numpy accepted on rebuild iterations); returns
+    def decode(self, state, samp=None):
+        """One fused decode step over the DEVICE-RESIDENT packed state
+        (numpy accepted on rebuild iterations); returns
         ``(emitted_tokens, new_state)`` with new_state still on device —
         the caller syncs emitted once per step and feeds new_state
-        straight back while no row changes."""
-        nxt, new_state, self._pages = self._decode_fn(self._pages, state)
+        straight back while no row changes. ``samp`` as in
+        :meth:`prefill_batch`; greedy rows inside a sampled batch stay
+        bit-identical to the plain program's argmax."""
+        if samp is None:
+            nxt, new_state, self._pages = self._decode_fn(self._pages, state)
+        else:
+            nxt, new_state, self._pages = self._decode_samp_fn(
+                self._pages, state, samp[0], samp[1]
+            )
         return nxt, new_state
+
+    def verify(self, state, drafts, samp=None):
+        """Speculative-decode scoring: one packed chunk dispatch runs the
+        target over each row's last token + ``k`` draft proposals and
+        returns the target's own pick at every position as numpy
+        ``[slots, k + 1]`` (gpt.verify_step_packed). The caller accepts
+        the longest agreeing prefix; emitted streams stay token-identical
+        to plain decoding at the same seeds regardless of the draft."""
+        import numpy as np
+
+        if samp is None:
+            picks, self._pages = self._verify_fn(self._pages, state, drafts)
+        else:
+            picks, self._pages = self._verify_samp_fn(
+                self._pages, state, drafts, samp[0], samp[1]
+            )
+        return np.asarray(picks)
 
     # -- KV handoff seam (runtime/handoff.py) --------------------------------
 
@@ -559,15 +684,27 @@ class PagedGptDecoder:
         each exported leaf is the buffer's contiguous
         ``[n_pages*ps, heads, head_dim]`` block. All leaves gather in
         one jitted program, then sync to host; a device-to-device
-        transport reads the same row ranges without the host hop."""
-        import jax
+        transport reads the same row ranges without the host hop.
+
+        The gather index is padded to the fixed ``pages_per_slot``
+        extent with trash-page rows (sliced off after the host sync), so
+        every export — disagg handoff or preemption spill — runs the
+        SAME compiled program regardless of the row's page count. Same
+        full-extent trade as the dense paged-attention gather
+        (models/transformer.py, PALLAS SEAM): pay bounded junk traffic
+        for a shape-stable one-program hot path."""
         import numpy as np
 
         ps = self.page_size
+        n = len(page_ids)
+        padded = list(page_ids) + [0] * max(self.pages_per_slot - n, 0)
         idx = np.concatenate(
-            [np.arange(p * ps, (p + 1) * ps) for p in page_ids]
+            [np.arange(p * ps, (p + 1) * ps) for p in padded]
         )
-        return [np.asarray(leaf) for leaf in self._export_fn(self._pages, idx)]
+        return [
+            np.asarray(leaf)[: n * ps]
+            for leaf in self._export_fn(self._pages, idx)
+        ]
 
     def import_kv(self, kv_leaves, page_ids) -> None:
         """Land exported K/V rows into THIS replica's pool at
@@ -584,19 +721,32 @@ class PagedGptDecoder:
                 f"buffer carries {len(kv_leaves)} kv leaves, model has "
                 f"{len(leaves)} — incompatible model config"
             )
-        idx = np.concatenate(
-            [np.arange(p * ps, (p + 1) * ps) for p in page_ids]
-        )
+        n_rows = len(page_ids) * ps
         for i, (leaf, src) in enumerate(zip(leaves, kv_leaves)):
             if (
                 tuple(src.shape[1:]) != tuple(leaf.shape[1:])
-                or src.shape[0] != len(idx)
+                or src.shape[0] != n_rows
             ):
                 raise HandoffError(
                     f"kv leaf {i} is {tuple(src.shape)}, pool expects "
-                    f"[{len(idx)}, {', '.join(map(str, leaf.shape[1:]))}]"
+                    f"[{n_rows}, {', '.join(map(str, leaf.shape[1:]))}]"
                 )
-        self._pages = self._import_fn(self._pages, list(kv_leaves), idx)
+        # pad the scatter to the fixed pages_per_slot extent — the extra
+        # rows land in trash page 0, which no live row ever reads — so
+        # every import (handoff or preemption restore) shares ONE
+        # compiled program (see export_kv)
+        pad = max(self.pages_per_slot - len(page_ids), 0)
+        padded = list(page_ids) + [0] * pad
+        idx = np.concatenate(
+            [np.arange(p * ps, (p + 1) * ps) for p in padded]
+        )
+        srcs = [
+            np.concatenate(
+                [src, np.zeros((pad * ps,) + src.shape[1:], src.dtype)]
+            ) if pad else np.asarray(src)
+            for src in kv_leaves
+        ]
+        self._pages = self._import_fn(self._pages, srcs, idx)
 
 
 @dataclass(eq=False)  # identity semantics: deque.remove / slots.index
@@ -629,6 +779,17 @@ class _GenRequest:
     decode_budget: int = 0
     handoff: Optional[KVHandoffBuffer] = None
     exported: Optional[KVHandoffBuffer] = None
+    # per-request sampling knobs (temperature, top_k, top_p, seed) — None
+    # means greedy, the bit-identical argmax path. The seed + the
+    # absolute-position PRNG fold is what makes a sampled stream survive
+    # a preempt/spill/restore cycle unchanged.
+    sampling: Optional[_SamplingTuple] = None
+    # scheduler accounting: how many times this request was preempted,
+    # and the ORIGINAL prompt length (captured at the first spill —
+    # spills absorb emitted tokens into ``tokens``, so the resident
+    # stream must be rebuilt from the immutable prompt every time)
+    preempt_count: int = 0
+    prompt_len: int = 0
 
     def wall(self, t: float) -> float:
         """Map a perf_counter stamp onto the wall clock."""
@@ -642,6 +803,11 @@ class _Slot:
     idx: int = 0                 # fixed row in the slot bank / step state
     position: int = 0            # absolute write position of the NEXT token
     last_token: int = 0
+    # speculative decode: the tokens emitted by this row's LAST round
+    # (the draft engine's catch-up chunk; position of chunk[0] is
+    # position - len(chunk) + 1). None/empty means the draft has nothing
+    # to catch up on and the row sits out speculative rounds.
+    spec_chunk: Optional[List[int]] = None
 
 
 class DecodeLoopExecutor:
@@ -679,8 +845,13 @@ class DecodeLoopExecutor:
         metrics: Optional[Metrics] = None,
         labels: Optional[Dict[str, str]] = None,
         prefix_cache: bool = True,
+        sched_policy: str = "fifo",
+        preemption: bool = True,
+        aging_s: float = 5.0,
+        speculative: Any = None,
     ):
         from tfk8s_tpu.runtime.paging import PageAllocator
+        from tfk8s_tpu.runtime.sched import make_scheduler
 
         self.model = model
         # vocab bound for the per-row malformed-continuation check; a
@@ -702,7 +873,17 @@ class DecodeLoopExecutor:
             model.max_pages, model.page_size, prefix_cache=prefix_cache
         )
         self._cond = threading.Condition()
-        self._q: deque = deque()
+        # admission order is a pluggable policy (runtime/sched): FIFO is
+        # the PR-7 behavior bit-identical; "priority" adds the per-class
+        # weighted pick + page-spill preemption
+        self._q = make_scheduler(sched_policy, aging_s=aging_s)
+        self._preemption = bool(preemption) and sched_policy == "priority"
+        # speculative decode engine (runtime/sched/speculative) — None
+        # runs plain one-token steps; set via serve() env or tests
+        self._spec = speculative
+        self._known_priorities: set = set()
+        self.preempted_total = 0
+        self.restored_total = 0
         self._slots: List[Optional[_Slot]] = [None] * model.slots
         self._live = 0
         self._draining = False
@@ -720,6 +901,7 @@ class DecodeLoopExecutor:
         # row — steady-state decode feeds the previous step's output
         # state straight back
         self._d_state = None
+        self._d_samp = None  # per-row sampling knobs, rebuilt with it
         self._state_dirty = True
         # fault containment (ISSUE 13): a non-None fault means a GLOBAL
         # failure (device unusable) — the loop is dead, submits refuse
@@ -758,6 +940,15 @@ class DecodeLoopExecutor:
             ("tfk8s_disagg_imports_total",
              "Handoff buffers imported directly into decode slots "
              "(no local prefill)."),
+            ("tfk8s_sched_preemptions_total",
+             "Rows evicted mid-decode by the priority scheduler, by "
+             "reason (page_pressure = spilled and requeued; "
+             "spill_failed = export failed, request failed typed)."),
+            ("tfk8s_sched_queue_depth",
+             "Queued requests per priority class (priority label)."),
+            ("tfk8s_sched_spec_accept_ratio",
+             "Speculative decode: accepted draft tokens / proposed, "
+             "cumulative."),
         ):
             self.metrics.describe(name, help_text)
 
@@ -822,19 +1013,23 @@ class DecodeLoopExecutor:
         per-token timeline and retires it as a ``serve.request`` span
         under that parent; tenant/priority label its TTFT/TPOT."""
         try:
-            tokens, gen = self.model.validate(payload)
+            parts = self.model.validate(payload)
         except InvalidRequest:
             self.metrics.inc(
                 "tfk8s_serving_requests_total", 1.0,
                 {**self.labels, "outcome": "invalid"},
             )
             raise
+        # test doubles may still speak the historical 2-tuple contract
+        tokens, gen = parts[0], parts[1]
+        sampling = parts[2] if len(parts) > 2 else None
         if self._chaos_delay_s:
             time.sleep(self._chaos_delay_s)  # gray replica: alive but slow
         req = _GenRequest(
             tokens=tokens, gen_budget=gen, enqueue_t=time.perf_counter(),
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
+            sampling=sampling,
         )
         return self._enqueue_and_wait(req, timeout)
 
@@ -850,27 +1045,29 @@ class DecodeLoopExecutor:
         (``decode_budget``); THIS replica only ever holds the row for
         one output token."""
         try:
-            tokens, gen = self.model.validate(payload)
+            parts = self.model.validate(payload)
         except InvalidRequest:
             self.metrics.inc(
                 "tfk8s_serving_requests_total", 1.0,
                 {**self.labels, "outcome": "invalid"},
             )
             raise
+        tokens, gen = parts[0], parts[1]
+        sampling = parts[2] if len(parts) > 2 else None
         if self._chaos_delay_s:
             time.sleep(self._chaos_delay_s)
         req = _GenRequest(
             tokens=tokens, gen_budget=1, enqueue_t=time.perf_counter(),
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
-            prefill_only=True, decode_budget=gen,
+            prefill_only=True, decode_budget=gen, sampling=sampling,
         )
         return self._enqueue_and_wait(req, timeout)
 
     def submit_handoff(self, buf: KVHandoffBuffer,
                        timeout: Optional[float] = 30.0,
                        traceparent: Optional[str] = None, tenant: str = "",
-                       priority: int = 0) -> Any:
+                       priority: int = 0, sampling: Any = None) -> Any:
         """Decode-pool entry point (disaggregated serving): admit a row
         whose prefill already happened elsewhere. The buffer's K/V pages
         land in freshly drawn local pages (prefix-cached pages are NOT
@@ -878,7 +1075,11 @@ class DecodeLoopExecutor:
         prefill replica's pick, and decoding continues bit-identically
         to a local prefill. Raises :class:`HandoffError` on a buffer
         this replica cannot import (wrong page size / model version /
-        integrity failure); otherwise the :meth:`submit` contract."""
+        integrity failure); otherwise the :meth:`submit` contract.
+        ``sampling`` re-applies the request's original sampling knobs on
+        the decode side (the buffer carries tokens/KV only) — the same
+        seed + absolute-position fold makes the continued stream
+        bit-identical to a single-replica sampled run."""
         import numpy as np
 
         buf.verify()
@@ -909,6 +1110,8 @@ class DecodeLoopExecutor:
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
             handoff=buf,
+            sampling=sampling if isinstance(sampling, tuple)
+            else _parse_sampling(sampling),
         )
         return self._enqueue_and_wait(req, timeout)
 
@@ -932,6 +1135,7 @@ class DecodeLoopExecutor:
             self.metrics.set_gauge(
                 "tfk8s_serving_queue_depth", float(len(self._q)), self.labels
             )
+            self._sched_gauges_locked()
             self._cond.notify_all()
         if not req.done.wait(timeout):
             timed_out = False
@@ -947,6 +1151,7 @@ class DecodeLoopExecutor:
                         "tfk8s_serving_queue_depth", float(len(self._q)),
                         self.labels,
                     )
+                    self._sched_gauges_locked()
                 except ValueError:
                     pass  # already admitted into a slot; it will finish
             if timed_out and req.traceparent:
@@ -968,28 +1173,52 @@ class DecodeLoopExecutor:
 
     # -- the decode loop ----------------------------------------------------
 
+    def _sched_gauges_locked(self) -> None:
+        """Per-priority-class queue depth gauges. Classes seen once keep
+        reporting (at zero) so a drained class doesn't leave a stale
+        last value on the scrape."""
+        depths = self._q.class_depths()
+        self._known_priorities.update(depths)
+        for p in self._known_priorities:
+            self.metrics.set_gauge(
+                "tfk8s_sched_queue_depth", float(depths.get(p, 0)),
+                {**self.labels, "priority": str(p)},
+            )
+
     def _admit_locked(self) -> List[_Slot]:
         """Move queued requests into free slots while the page pool covers
-        them (FIFO — a stalled head blocks later admissions so a stream
-        of small requests can't starve a big one). Caller holds the
-        lock."""
+        them. Order is the scheduler's pick — FIFO by default (a stalled
+        head blocks later admissions so a stream of small requests can't
+        starve a big one), or the aged priority-weighted pick. Under the
+        priority policy, a pick that stalls on pages may PREEMPT a
+        lower-priority live row: its KV spills to a host-side buffer
+        (the handoff serialize path), its request re-enters at the front
+        of its class, and admission retries with the freed pages. Caller
+        holds the lock."""
         from tfk8s_tpu.runtime.paging import OutOfPages
 
         admitted: List[_Slot] = []
-        while self._q and self._live < len(self._slots):
-            req = self._q[0]
+        while self._live < len(self._slots):
+            req = self._q.peek()
+            if req is None:
+                break
             try:
                 if req.handoff is not None:
-                    # handoff rows draw their prompt pages NOW so the
-                    # imported K/V has somewhere to land before step 1
+                    # handoff rows (disagg import OR preemption restore)
+                    # draw their prompt pages NOW so the imported K/V
+                    # has somewhere to land before step 1; the buffer's
+                    # gen_budget is the REMAINING budget after any
+                    # already-emitted tokens
                     lease = self.allocator.import_pages(
-                        req.tokens, req.gen_budget
+                        req.tokens, req.handoff.gen_budget
                     )
                 else:
                     lease = self.allocator.admit(req.tokens, req.gen_budget)
             except OutOfPages:
+                if self._preemption and self._maybe_preempt_locked(req):
+                    continue  # pages freed (or victim failed); retry
                 break  # admission stalls; retirements will free pages
-            self._q.popleft()
+            self._q.pop(req)
             if lease.cached_pages:
                 self.metrics.inc(
                     "tfk8s_serving_prefix_cache_hits_total", 1.0, self.labels
@@ -1010,7 +1239,98 @@ class DecodeLoopExecutor:
             self.metrics.set_gauge(
                 "tfk8s_serving_queue_depth", float(len(self._q)), self.labels
             )
+            self._sched_gauges_locked()
         return admitted
+
+    def _maybe_preempt_locked(self, req: _GenRequest) -> bool:
+        """A higher-priority admission stalled on pages: evict the
+        lowest-priority live row strictly below the stalled request's
+        class (youngest first — least sunk cost), spilling its KV to a
+        host buffer and requeueing it at the front of its class. Returns
+        True when a victim was evicted (the admission loop retries),
+        False when no eligible victim exists (the admission stalls, the
+        pre-preemption behavior). A spill failure fails the VICTIM typed
+        (:class:`Preempted`) with its pages quarantined — still True:
+        the slot is free either way. Caller holds the lock (this runs on
+        the loop thread inside the admission pass, so no step is in
+        flight while rows move)."""
+        from tfk8s_tpu.runtime.sched.scheduler import pick_victim
+
+        victim = pick_victim(self._slots, int(req.priority))
+        if victim is None:
+            return False
+        try:
+            self._spill_locked(victim)
+        except BaseException as e:  # noqa: BLE001 — contain to the victim
+            vreq = victim.req
+            self.allocator.quarantine(victim.lease)
+            self._slots[victim.idx] = None
+            self._live -= 1
+            self._state_dirty = True
+            self.preempted_total += 1
+            self.metrics.inc(
+                "tfk8s_sched_preemptions_total", 1.0,
+                {**self.labels, "reason": "spill_failed"},
+            )
+            self.metrics.inc(
+                "tfk8s_serving_requests_total", 1.0,
+                {**self.labels, "outcome": "error"},
+            )
+            log.warning("preemption spill failed, victim request lost: %s", e)
+            vreq.error = Preempted(f"KV spill failed mid-preemption: {e}")
+            vreq.done.set()
+            return True
+        self.preempted_total += 1
+        self.metrics.inc(
+            "tfk8s_sched_preemptions_total", 1.0,
+            {**self.labels, "reason": "page_pressure"},
+        )
+        return True
+
+    def _spill_locked(self, victim: _Slot) -> None:
+        """Serialize a live row's whole KV state into a
+        :class:`KVHandoffBuffer` riding its own request, free its pages
+        and slot, and requeue it at the front of its priority class. The
+        restore is the existing handoff-import admission path, so a
+        resumed row continues BIT-IDENTICAL to an unpreempted run: the
+        resident tokens (prompt + all-but-last emitted) become the
+        buffer's prompt, the last emitted token seeds the decode, and
+        the buffer's gen_budget is the remaining budget. The resident
+        stream is rebuilt from the ORIGINAL prompt every time —
+        ``req.tokens`` absorbs emitted tokens on restore, so a second
+        spill concatenating ``req.tokens + out[:-1]`` would duplicate
+        them (wrong positions, digest chain, and KV extent)."""
+        req = victim.req
+        if req.preempt_count == 0:
+            # req.tokens is still the pristine prompt only BEFORE the
+            # first spill rewrites it below
+            req.prompt_len = len(req.tokens)
+        resident = [int(t) for t in req.tokens[:req.prompt_len]] + [
+            int(t) for t in req.out[:-1]
+        ]
+        last = int(req.out[-1])
+        page_ids, digests = self.allocator.export_pages(victim.lease, resident)
+        buf = KVHandoffBuffer(
+            version=self.model.version, page_size=self.model.page_size,
+            tokens=resident, last_token=last,
+            # remaining budget: len(req.out) already emitted, and the
+            # buffer's last_token re-enters req.out on restore
+            gen_budget=req.gen_budget - len(req.out) + 1,
+            digests=digests,
+            kv=self.model.export_kv(page_ids),
+        )
+        import numpy as np
+
+        req.out.pop()  # re-enters as buf.last_token on restore
+        req.handoff = buf
+        req.tokens = np.asarray(resident, np.int32)
+        req.preempt_count += 1
+        self.allocator.release(victim.lease)
+        self._slots[victim.idx] = None
+        self._live -= 1
+        self._state_dirty = True
+        self._q.requeue_front(req)
+        self._sched_gauges_locked()
 
     def _loop(self) -> None:
         while True:
@@ -1042,6 +1362,48 @@ class DecodeLoopExecutor:
         ps = self.model.page_size
         while len(slot.lease.pages) * ps < upto_tokens:
             self.allocator.extend(slot.lease)
+
+    def _prefill_samp(self, pending, rows: int):
+        """Per-row sampling knobs for one prefill round — None when every
+        pending row is greedy (keeps the original compiled program on
+        the pure-greedy path, bit-identical)."""
+        import numpy as np
+
+        if not any(e[0].req.sampling for e in pending):
+            return None
+        samp_f = np.zeros((rows, 2), np.float32)
+        samp_f[:, 1] = 1.0  # top_p disabled by default
+        samp_i = np.zeros((rows, 2), np.int32)
+        for entry in pending:
+            slot = entry[0]
+            if slot.req.sampling is None:
+                continue
+            t, k, p, s = slot.req.sampling
+            r = 0 if rows == 1 else slot.idx
+            samp_f[r] = (t, p)
+            samp_i[r] = (k, s)
+        return samp_f, samp_i
+
+    def _slot_samp(self):
+        """Per-row sampling knobs for the decode/verify dispatch, aligned
+        to the slot bank — None when every live row is greedy."""
+        import numpy as np
+
+        if not any(
+            s is not None and s.req.sampling for s in self._slots
+        ):
+            return None
+        n = len(self._slots)
+        samp_f = np.zeros((n, 2), np.float32)
+        samp_f[:, 1] = 1.0
+        samp_i = np.zeros((n, 2), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.req.sampling is None:
+                continue
+            t, k, p, s = slot.req.sampling
+            samp_f[i] = (t, p)
+            samp_i[i] = (k, s)
+        return samp_f, samp_i
 
     def _prefill_admitted(self, admitted: List[_Slot]) -> None:
         """Batched chunked prefill: every admitted request's NEXT prompt
@@ -1106,7 +1468,18 @@ class DecodeLoopExecutor:
                 if end >= plen:
                     finishing.append((slot, r, plen - 1 - base))
                 entry[1] = end
-            picks = self.model.prefill_batch(batch)
+            samp = self._prefill_samp(pending, rows)
+            # keep the 1-arg call when every row is greedy: test doubles
+            # (and the draft mirror) override prefill_batch(batch)
+            picks = (
+                self.model.prefill_batch(batch) if samp is None
+                else self.model.prefill_batch(batch, samp)
+            )
+            if self._spec is not None:
+                # mirror the dispatch into the draft pool: same packed
+                # rows, same page ids — the draft's prompt K/V must be
+                # resident before its first proposal round
+                self._spec.prefill_batch(batch)
             now = time.perf_counter()
             for slot, r, pick_idx in finishing:
                 req = slot.req
@@ -1114,6 +1487,8 @@ class DecodeLoopExecutor:
                 self.allocator.register_prefix(req.tokens, slot.lease)
                 slot.position = len(req.tokens)
                 slot.last_token = first_tok
+                if self._spec is not None:
+                    slot.spec_chunk = [first_tok]
                 req.out.append(first_tok)
                 req.first_token_t = now
                 self.tokens_total += 1
@@ -1156,8 +1531,10 @@ class DecodeLoopExecutor:
         ps = self.model.page_size
         plen = len(req.tokens)
         # whole lease up front, like the prefill path: the page table
-        # never grows mid-decode
-        self._pages_for(slot, plen + max(req.gen_budget, 1))
+        # never grows mid-decode. The BUFFER's gen_budget bounds the draw
+        # — for a preemption restore it is the REMAINING budget, which is
+        # exactly what import_pages reserved.
+        self._pages_for(slot, plen + max(buf.gen_budget, 1))
         n_prompt = -(-plen // ps)
         dst = slot.lease.pages[slot.lease.cached_pages:n_prompt]
         if dst:
@@ -1168,11 +1545,29 @@ class DecodeLoopExecutor:
         self.allocator.register_prefix(req.tokens, slot.lease)
         slot.position = plen
         slot.last_token = buf.last_token
+        if self._spec is not None:
+            # the draft never saw this KV (it arrived as a buffer):
+            # rebuild its prompt KV from the tokens, then let the normal
+            # catch-up chunk handle the seeded last token
+            self._spec.prefill_tokens(
+                [int(t) for t in req.tokens], list(slot.lease.pages)
+            )
+            slot.spec_chunk = [int(buf.last_token)]
         req.out.append(buf.last_token)
-        req.first_token_t = time.perf_counter()
-        # the first token was generated (and counted in the token
-        # metrics) on the PREFILL replica; importing it emits nothing
-        self.metrics.inc("tfk8s_disagg_imports_total", 1.0, self.labels)
+        if req.preempt_count:
+            # a preemption restore on THIS replica: the row already
+            # emitted output here, so its original first_token_t stands
+            # (TTFT/TPOT stay anchored to the real first token) and the
+            # import counts as a scheduler restore, not a disagg handoff
+            self.restored_total += 1
+            self.metrics.inc(
+                "tfk8s_sched_restores_total", 1.0, self.labels
+            )
+        else:
+            # the first token was generated (and counted in the token
+            # metrics) on the PREFILL replica; importing it emits nothing
+            req.first_token_t = time.perf_counter()
+            self.metrics.inc("tfk8s_disagg_imports_total", 1.0, self.labels)
         if len(req.out) >= req.gen_budget or (
             self.model.eos_id is not None
             and buf.last_token == self.model.eos_id
@@ -1196,9 +1591,13 @@ class DecodeLoopExecutor:
             state[i, 1] = slot.position
             state[i, 2: 2 + len(slot.lease.pages)] = slot.lease.pages
         self._d_state = state
+        self._d_samp = self._slot_samp()
         self._state_dirty = False
 
     def _decode_once(self) -> None:
+        if self._spec is not None:
+            self._decode_spec_once()
+            return
         live = []
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -1210,7 +1609,12 @@ class DecodeLoopExecutor:
             live.append(i)
         if self._state_dirty:
             self._rebuild_state()
-        nxt_dev, state_dev = self.model.decode(self._d_state)
+        # keep the 1-arg call when every row is greedy: test doubles
+        # override decode(state) with the original arity
+        nxt_dev, state_dev = (
+            self.model.decode(self._d_state) if self._d_samp is None
+            else self.model.decode(self._d_state, self._d_samp)
+        )
         import numpy as np
 
         nxt = np.asarray(nxt_dev)  # the one per-step device sync
@@ -1258,6 +1662,136 @@ class DecodeLoopExecutor:
             self.metrics.inc(
                 "tfk8s_serving_tokens_total", float(emitted), self.labels
             )
+
+    def _decode_spec_once(self) -> None:
+        """One SPECULATIVE iteration: the draft proposes ``k`` tokens per
+        live row (catch-up chunk + greedy draft steps, all in the draft's
+        own page pool), the target verifies every proposal in ONE packed
+        chunk dispatch, and each row emits the longest agreeing prefix
+        plus the target's correction token — ``1..k+1`` target-identical
+        tokens per iteration instead of exactly one.
+
+        Rows within ``k`` positions of the page-table extent
+        (``pages_per_slot * page_size``) sit the round out and take a
+        plain single step instead: the verify chunk would otherwise
+        scatter K/V past the table and XLA's clamped indexing would
+        overwrite the row's own last page (the Pallas-seam accounting —
+        see models/transformer.py). Those rows are retiring within ``k``
+        tokens anyway."""
+        import numpy as np
+
+        k = self._spec.k
+        limit = self.model.pages_per_slot * self.model.page_size
+        if self._state_dirty:
+            self._rebuild_state()
+        state = np.asarray(self._d_state)
+        spec_rows, tail_rows = [], []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.spec_chunk and slot.position + k < limit:
+                spec_rows.append(i)
+            else:
+                tail_rows.append(i)
+        self.batches_total += 1
+        self._occupancy_sum += len(spec_rows) + len(tail_rows)
+        self.metrics.inc("tfk8s_serving_batches_total", 1.0, self.labels)
+        self.metrics.set_gauge(
+            "tfk8s_serving_batch_occupancy", self.mean_batch_occupancy,
+            self.labels,
+        )
+        emitted_n = 0
+        if spec_rows:
+            spec_set = set(spec_rows)
+            sslots = [
+                s if i in spec_set else None
+                for i, s in enumerate(self._slots)
+            ]
+            drafts = self._spec.propose(sslots)
+            vstate = state.copy()
+            for i in tail_rows:
+                vstate[i] = 0  # inert: junk writes land in the trash page
+            picks = self.model.verify(vstate, drafts, self._d_samp)
+            step_t = time.perf_counter()
+            for i in spec_rows:
+                emitted_n += self._accept_spec_row(
+                    i, drafts[i], picks[i], step_t
+                )
+            self.metrics.set_gauge(
+                "tfk8s_sched_spec_accept_ratio", self._spec.accept_ratio,
+                self.labels,
+            )
+        if tail_rows:
+            tstate = state.copy()
+            for i in spec_rows:
+                tstate[i] = 0
+            nxt = np.asarray(
+                (self.model.decode(tstate) if self._d_samp is None
+                 else self.model.decode(tstate, self._d_samp))[0]
+            )
+            step_t = time.perf_counter()
+            for i in tail_rows:
+                slot = self._slots[i]
+                if slot is None:
+                    continue
+                emitted_n += self._accept_spec_row(
+                    i, np.zeros(0, np.int32), nxt[i:i + 1], step_t
+                )
+        # positions advanced by a per-row amount: the packed state must
+        # re-materialize before the next iteration either way
+        self._state_dirty = True
+        self.tokens_total += emitted_n
+        if emitted_n:
+            self.metrics.inc(
+                "tfk8s_serving_tokens_total", float(emitted_n), self.labels
+            )
+
+    def _accept_spec_row(self, i: int, drafts, picks, step_t: float) -> int:
+        """Accept-prefix for one row: longest ``drafts[j] == picks[j]``
+        prefix, then the target's own correction token — truncated to
+        the remaining budget and (inclusively) to eos. Returns how many
+        tokens the row emitted. Empty ``drafts`` (a tail row's plain
+        step) degenerates to emitting ``picks[0]``."""
+        slot = self._slots[i]
+        if slot is None:
+            return 0  # a chaos crash raced the step and cleared it
+        req = slot.req
+        a = 0
+        while a < len(drafts) and int(drafts[a]) == int(picks[a]):
+            a += 1
+        toks = [int(t) for t in drafts[:a]] + [int(picks[a])]
+        if len(drafts):
+            self._spec.record(proposed=len(drafts), accepted=a)
+        remaining = req.gen_budget - len(req.out)
+        toks = toks[:remaining]
+        if self.model.eos_id is not None and self.model.eos_id in toks:
+            toks = toks[: toks.index(self.model.eos_id) + 1]
+        if self._chaos_poison and toks:
+            # per emitted token, like the plain path's per-step check —
+            # an armed key is one-shot, so exactly one token poisons
+            toks = [self._apply_chaos_poison(slot, t) for t in toks]
+        for tok in toks:
+            if tok < 0 or (
+                self._vocab_bound is not None and tok >= self._vocab_bound
+            ):
+                self._retire_failed(slot, RowFault(
+                    f"row {slot.idx} emitted malformed token {tok} "
+                    f"(vocab {self._vocab_bound}) at position "
+                    f"{slot.position}; row retired, pages quarantined"
+                ))
+                return 0
+        slot.position += len(toks)
+        slot.last_token = toks[-1]
+        slot.spec_chunk = list(toks)
+        req.out.extend(toks)
+        if req.traceparent:
+            req.token_times.extend([step_t] * len(toks))
+        if len(req.out) >= req.gen_budget or (
+            self.model.eos_id is not None
+            and toks[-1] == self.model.eos_id
+        ):
+            self._retire(slot)
+        return len(toks)
 
     def _retire(self, slot: _Slot) -> None:
         """Complete a finished request and free its pages — the slot is
@@ -1447,6 +1981,7 @@ class DecodeLoopExecutor:
             self.metrics.set_gauge(
                 "tfk8s_serving_queue_depth", 0.0, self.labels
             )
+            self._sched_gauges_locked()
         if victims:
             self.metrics.inc(
                 "tfk8s_serving_requests_total", float(len(victims)),
@@ -1558,12 +2093,28 @@ class DecodeLoopExecutor:
                     "priority": req.priority,
                     "trace_id": _trace_id_of(req.traceparent),
                 })
+            sched: Dict[str, Any] = {
+                "policy": getattr(self._q, "policy", "fifo"),
+                "queue_by_priority": {
+                    str(p): d for p, d in sorted(self._q.class_depths().items())
+                },
+                "preemptions": self.preempted_total,
+                "restores": self.restored_total,
+            }
+            if self._spec is not None:
+                sched["speculative"] = {
+                    "k": self._spec.k,
+                    "proposed": self._spec.proposed_total,
+                    "accepted": self._spec.accepted_total,
+                    "accept_ratio": round(self._spec.accept_ratio, 4),
+                }
             return {
                 "kind": "decode_loop",
                 "queue_depth": len(self._q),
                 "live_slots": self._live,
                 "slot_capacity": len(self._slots),
                 "slots": slots,
+                "scheduler": sched,
                 "pages_used": self.allocator.used_pages,
                 "pages_total": self.allocator.num_pages,
                 "served_total": self.served_total,
@@ -2185,12 +2736,25 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
             ),
         )
         model.load()  # Ready is honest: the weights are resident before it
+        speculative = None
+        if env.get("TFK8S_SERVE_SPEC_DECODE", "0") != "0":
+            from tfk8s_tpu.runtime.sched import SpeculativeEngine
+
+            speculative = SpeculativeEngine.build(
+                model,
+                k=int(env.get("TFK8S_SERVE_SPEC_TOKENS", "4")),
+                size=env.get("TFK8S_SERVE_SPEC_DRAFT", "tiny"),
+            )
         server = DecodeLoopExecutor(
             model,
             queue_limit=queue_limit,
             metrics=get_metrics(),
             labels=labels,
             prefix_cache=env.get("TFK8S_SERVE_PREFIX_CACHE", "1") != "0",
+            sched_policy=env.get("TFK8S_SERVE_SCHED_POLICY", "fifo"),
+            preemption=env.get("TFK8S_SERVE_PREEMPTION", "1") != "0",
+            aging_s=float(env.get("TFK8S_SERVE_AGING_S", "5.0")),
+            speculative=speculative,
         ).start()
     else:
         model = make_model(task, checkpoint, max_batch, env)
@@ -2409,6 +2973,7 @@ __all__ = [
     "ModelServer",
     "Overloaded",
     "PagedGptDecoder",
+    "Preempted",
     "QuotaExceeded",
     "ReplicaUnavailable",
     "RequestFailed",

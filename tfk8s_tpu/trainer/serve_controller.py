@@ -153,6 +153,14 @@ def render_serve_pod(
         # decode-loop knobs (generative tasks): paged KV-cache geometry
         "TFK8S_SERVE_PAGE_SIZE": str(spec.batching.page_size),
         "TFK8S_SERVE_MAX_PAGES": str(spec.batching.max_pages),
+        # token-scheduler knobs (runtime/sched): admission policy,
+        # page-spill preemption, speculative decode
+        "TFK8S_SERVE_SCHED_POLICY": spec.batching.scheduler.policy,
+        "TFK8S_SERVE_PREEMPTION": "1" if spec.batching.scheduler.preemption else "0",
+        "TFK8S_SERVE_AGING_S": str(spec.batching.scheduler.aging_s),
+        "TFK8S_SERVE_SPEC_DECODE": "1" if spec.batching.scheduler.spec_decode else "0",
+        "TFK8S_SERVE_SPEC_TOKENS": str(spec.batching.scheduler.spec_tokens),
+        "TFK8S_SERVE_SPEC_DRAFT": spec.batching.scheduler.spec_draft,
     }
     if phase:
         env["TFK8S_SERVE_PHASE"] = phase

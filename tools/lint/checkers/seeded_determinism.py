@@ -33,6 +33,9 @@ from tools.lint.base import Checker, Finding, Module, QualnameVisitor, dotted_na
 SCOPE_PREFIXES = (
     "tfk8s_tpu/data/",
     "tfk8s_tpu/runtime/checkpoint.py",
+    # per-request sampling PRNG (seed + absolute-position fold) must
+    # survive resume bit-identically — no wall-clock or ambient RNG
+    "tfk8s_tpu/runtime/sched/",
     "tests/chaos.py",
 )
 

@@ -33,6 +33,8 @@ SCOPE = (
     "tfk8s_tpu/runtime/registry.py",
     "tfk8s_tpu/runtime/paging.py",
     "tfk8s_tpu/runtime/handoff.py",
+    "tfk8s_tpu/runtime/sched/scheduler.py",
+    "tfk8s_tpu/runtime/sched/speculative.py",
     "tfk8s_tpu/gateway/server.py",
     "tfk8s_tpu/gateway/affinity.py",
     "tfk8s_tpu/gateway/router.py",
