@@ -1940,6 +1940,229 @@ def _disagg_serving_probe(small: bool, full: bool = False):
     }
 
 
+def _kv_economy_probe(small: bool, full: bool = False):
+    """Global KV economy (ISSUE 17), two claims:
+
+    A) HOST TIER: a many-session round-robin whose working set of
+       prefixes overflows the tight device page pool, so every revisit
+       finds its pages already evicted from device. With the host tier
+       on, ``_evict_idle`` DEMOTED them to host RAM and the revisit
+       restores through the handoff-import path; with it off (the PR 14
+       baseline behavior) the revisit re-prefills from scratch. Both
+       arms run identical prompts on identical executors and count the
+       tokens the prefill loop actually computed (prompt length minus
+       the lease's cached pages, hooked at ``allocator.admit`` — the
+       exact quantity the chunked-prefill loop skips). Reported:
+       ``kv_reprefill_saved`` — the driver's acceptance key, judged
+       against the PR 14 affinity baseline of 0.6.
+
+    B) PEER TIER: replica A is warm with N distinct long prompts;
+       cold replica B submits the same prompts with a ``kv_peer`` hint
+       (what the gateway's cache directory supplies) and pulls the
+       prefix over the KV transport, while equally-cold replica C
+       re-prefills them. TTFT p99 of the hinted pulls vs the
+       re-prefills — every fetch pays the full export/serialize/
+       round-trip/verify/import tax.
+
+    Both parts also assert the economy's core contract inline: tiered
+    and flat arms must emit IDENTICAL tokens (a restore or fetch that
+    changed the stream would be a correctness bug, not a perf win)."""
+    import numpy as np
+
+    from tfk8s_tpu.runtime.handoff import LocalKVTransport
+    from tfk8s_tpu.runtime.server import DecodeLoopExecutor, PagedGptDecoder
+    from tfk8s_tpu.utils.logging import Metrics
+
+    small_mode = small and not full
+    # Geometry notes: the host working set must OVERFLOW the device pool
+    # (sessions * idle chain pages > max_pages) so round-robin revisits
+    # always miss device, while the pool still holds the largest single
+    # lease. The peer pool must NOT overflow (A keeps every prompt warm).
+    if small_mode:
+        size, vocab = "tiny", 64
+        slots, page_size, chunk, gen = 8, 4, 4, 4
+        host_sessions, host_rounds, host_prefix = 12, 5, 40
+        host_max_pages, host_bytes = 64, 32 << 20
+        peer_prompts, peer_prefix, peer_max_pages = 24, 56, 512
+    else:
+        size, vocab = "mid", 256
+        slots, page_size, chunk, gen = 8, 8, 16, 8
+        host_sessions, host_rounds, host_prefix = 12, 5, 96
+        host_max_pages, host_bytes = 96, 256 << 20
+        peer_prompts, peer_prefix, peer_max_pages = 16, 192, 512
+
+    def mk(max_pages, host_b=0, peer_registry=None):
+        dec = PagedGptDecoder(
+            "seed:0", slots=slots, page_size=page_size, max_pages=max_pages,
+            gen_tokens=gen, size=size, prefill_chunk=chunk,
+        )
+        dec.load()
+        kwargs = {}
+        if peer_registry is not None:
+            kwargs = dict(
+                kv_peer_fetch=True,
+                kv_transport=LocalKVTransport(),
+                kv_peer_resolve=peer_registry.get,
+            )
+        return DecodeLoopExecutor(
+            dec, queue_limit=128, metrics=Metrics(),
+            kv_host_bytes=host_b, **kwargs,
+        ).start()
+
+    def count_prefilled(ex):
+        # hook admit: the chunked-prefill loop starts each request at
+        # lease.cached_pages * page_size, so plen minus that is exactly
+        # the token count it computes — device hits AND host restores
+        # (which land as cached pages before admit) both shrink it
+        counter = {"tokens": 0}
+        orig = ex.allocator.admit
+
+        def admit(tokens, gen_budget):
+            lease = orig(tokens, gen_budget)
+            counter["tokens"] += max(
+                0, len(tokens) - lease.cached_pages * page_size
+            )
+            return lease
+
+        ex.allocator.admit = admit
+        return counter
+
+    def p(vals, q):
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))] * 1000, 3)
+
+    # -- part A: re-prefilled tokens, host tier on vs off ----------------
+    rng = np.random.default_rng(1700)
+    host_prompts = [
+        rng.integers(1, vocab, size=host_prefix).astype(np.int32)
+        for _ in range(host_sessions)
+    ]
+    tiered = mk(host_max_pages, host_b=host_bytes)
+    flat = mk(host_max_pages)
+    restore_ttft, reprefill_ttft = [], []
+    identical = True
+    try:
+        # Compile-warm both executors before the counters go in — and
+        # warm the whole demote->restore path (the KV gather/scatter
+        # programs jit on first use): overflow the device pool with
+        # throwaway prompts so the first one demotes, then revisit it to
+        # force a restore. Symmetric submits keep the arms comparable.
+        warmups = [
+            np.full(host_prefix, v, np.int32)
+            for v in range(1, 2 + host_max_pages * page_size // host_prefix)
+        ]
+        for w in warmups + [warmups[0]]:
+            payload = {"tokens": w, "gen_tokens": gen}
+            tiered.submit(dict(payload), timeout=600)
+            flat.submit(dict(payload), timeout=600)
+        tiered_n, flat_n = count_prefilled(tiered), count_prefilled(flat)
+        for r in range(host_rounds):
+            for s in range(host_sessions):
+                payload = {"tokens": host_prompts[s], "gen_tokens": gen}
+                out_t = tiered.submit(dict(payload), timeout=600)
+                out_f = flat.submit(dict(payload), timeout=600)
+                identical = identical and (
+                    list(out_t["tokens"]) == list(out_f["tokens"])
+                )
+                if r:  # revisits only: round 0 is the cold fill
+                    restore_ttft.append(out_t["ttft_s"])
+                    reprefill_ttft.append(out_f["ttft_s"])
+        demotions = tiered.metrics.get_counter(
+            "tfk8s_serving_kv_host_ops_total", {"op": "demote"}
+        ) or 0
+        restores = tiered.metrics.get_counter(
+            "tfk8s_serving_kv_host_ops_total", {"op": "restore"}
+        ) or 0
+    finally:
+        tiered.drain(timeout=30)
+        flat.drain(timeout=30)
+    saved = (
+        round(1.0 - tiered_n["tokens"] / flat_n["tokens"], 3)
+        if flat_n["tokens"] else None
+    )
+
+    # -- part B: peer-fetch TTFT vs re-prefill TTFT ----------------------
+    registry = {}
+    warm_peer = mk(peer_max_pages)
+    registry["A"] = warm_peer
+    puller = mk(peer_max_pages, peer_registry=registry)
+    cold = mk(peer_max_pages)
+    rng = np.random.default_rng(1701)
+    peer_prompts_arr = [
+        rng.integers(1, vocab, size=peer_prefix).astype(np.int32)
+        for _ in range(peer_prompts)
+    ]
+    fetch_ttft, prefill_ttft = [], []
+    peer_identical = True
+    try:
+        for prompt in peer_prompts_arr:  # warm A with every prompt
+            warm_peer.submit(
+                {"tokens": prompt, "gen_tokens": gen}, timeout=600
+            )
+        # compile-warm on a throwaway prompt: the HINTED submit also
+        # jits A's export gather and B's import scatter off the clock
+        warm = {"tokens": np.ones(peer_prefix, np.int32), "gen_tokens": gen}
+        warm_peer.submit(dict(warm), timeout=600)
+        puller.submit(dict(warm), timeout=600, kv_peer="A")
+        cold.submit(dict(warm), timeout=600)    # no hint: plain prefill
+        fetches0 = puller.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "ok"}
+        ) or 0
+        for prompt in peer_prompts_arr:
+            out_b = puller.submit(
+                {"tokens": prompt, "gen_tokens": gen},
+                timeout=600, kv_peer="A",
+            )
+            out_c = cold.submit(
+                {"tokens": prompt, "gen_tokens": gen}, timeout=600
+            )
+            peer_identical = peer_identical and (
+                list(out_b["tokens"]) == list(out_c["tokens"])
+            )
+            fetch_ttft.append(out_b["ttft_s"])
+            prefill_ttft.append(out_c["ttft_s"])
+        fetches_ok = (puller.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "ok"}
+        ) or 0) - fetches0
+    finally:
+        for ex in (warm_peer, puller, cold):
+            ex.drain(timeout=30)
+
+    fetch_p99 = p(fetch_ttft, 0.99)
+    prefill_p99 = p(prefill_ttft, 0.99)
+    return {
+        "kv_model": f"gpt-{size}",
+        "kv_page_size": page_size,
+        "kv_prefill_chunk": chunk,
+        "kv_host_bytes": host_bytes,
+        "kv_host_sessions": host_sessions,
+        "kv_host_rounds": host_rounds,
+        "kv_host_prefix_tokens": host_prefix,
+        "kv_host_device_pages": host_max_pages,
+        "kv_tiered_prefilled_tokens": int(tiered_n["tokens"]),
+        "kv_flat_prefilled_tokens": int(flat_n["tokens"]),
+        "kv_reprefill_saved": saved,
+        "kv_host_demotions": int(demotions),
+        "kv_host_restores": int(restores),
+        "kv_host_restore_p50_ms": p(restore_ttft, 0.5),
+        "kv_host_restore_p99_ms": p(restore_ttft, 0.99),
+        "kv_host_reprefill_p50_ms": p(reprefill_ttft, 0.5),
+        "kv_host_reprefill_p99_ms": p(reprefill_ttft, 0.99),
+        "kv_restore_identical": bool(identical),
+        "kv_peer_prompts": peer_prompts,
+        "kv_peer_prefix_tokens": peer_prefix,
+        "kv_peer_fetches_ok": int(fetches_ok),
+        "kv_peer_fetch_p50_ms": p(fetch_ttft, 0.5),
+        "kv_peer_fetch_p99_ms": fetch_p99,
+        "kv_peer_reprefill_p50_ms": p(prefill_ttft, 0.5),
+        "kv_peer_reprefill_p99_ms": prefill_p99,
+        "kv_peer_fetch_identical": bool(peer_identical),
+        "kv_peer_ttft_win": (
+            round(prefill_p99 / fetch_p99, 2) if fetch_p99 else None
+        ),
+    }
+
+
 def _recovery_probe(small: bool, full: bool = False):
     """Elastic recovery time (ISSUE 6): kill 1 of 4 workers mid-epoch
     with a reclaim notice against the REAL job controller + hermetic
@@ -2506,6 +2729,19 @@ def main() -> None:
             print(f"bench: sched probe failed: {exc}", file=sys.stderr)
             degraded.append("sched")
 
+    # -- KV economy: tiered prefix residency (device -> host demote/
+    # restore) re-prefill savings and directory-hinted peer-fetch TTFT
+    # vs plain re-prefill (host-side, hermetic) --------------------------
+    kv_block = None
+    if os.environ.get("BENCH_KV_ECONOMY", "1") == "1":
+        try:
+            kv_block = _kv_economy_probe(
+                small, full=os.environ.get("BENCH_KV_ECONOMY_FULL") == "1"
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: kv economy probe failed: {exc}", file=sys.stderr)
+            degraded.append("kv_economy")
+
     # -- elastic recovery: reclaim-notice -> resized-gang-training time
     # against the real controller + kubelet (hermetic, chip-free) --------
     recovery_block = None
@@ -2728,6 +2964,7 @@ def main() -> None:
                         if disagg_block else {}
                     ),
                     **({"sched": sched_block} if sched_block else {}),
+                    **({"kv_economy": kv_block} if kv_block else {}),
                     **({"recovery": recovery_block} if recovery_block else {}),
                     **(
                         {
@@ -2794,7 +3031,7 @@ def main() -> None:
         build_headline(
             detail, image_block, detail_name, serving_block, recovery_block,
             gen_serving_block, gateway_block, chaos_block, disagg_block,
-            sched_block,
+            sched_block, kv_block,
         )
     )
 
@@ -2809,7 +3046,7 @@ HEADLINE_MAX_CHARS = 1800
 def build_headline(
     detail: dict, image_block, detail_name, serving_block=None,
     recovery_block=None, gen_serving_block=None, gateway_block=None,
-    chaos_block=None, disagg_block=None, sched_block=None,
+    chaos_block=None, disagg_block=None, sched_block=None, kv_block=None,
 ) -> str:
     """Assemble the final-stdout headline line from the full detail
     record: the fixed key set, the image-decode and serving rows when
@@ -2965,6 +3202,24 @@ def build_headline(
                 if k in sched_block
             }
         )
+    if kv_block:
+        # the KV-economy rows ride the headline: the re-prefill fraction
+        # the host tier saved over the untied device pool (the driver's
+        # acceptance key, judged against the PR 14 affinity baseline),
+        # the restore/fetch TTFT p99s, and the re-prefill p99 the peer
+        # fetch is judged against
+        headline_extra.update(
+            {
+                k: kv_block[k]
+                for k in (
+                    "kv_reprefill_saved",
+                    "kv_host_restore_p99_ms",
+                    "kv_peer_fetch_p99_ms",
+                    "kv_peer_reprefill_p99_ms",
+                )
+                if k in kv_block
+            }
+        )
     if recovery_block:
         # the elastic-recovery rows ride the headline: seconds from a
         # reclaim notice to the RESIZED gang's first post-resize optimizer
@@ -3000,6 +3255,7 @@ def build_headline(
         "gateway_wire_efficiency", "gateway_p99_ms",
         "chaos_p99_ms", "ejection_time_ms",
         "sched_hi_tpot_p99_ms_fifo", "sched_preemptions",
+        "kv_peer_reprefill_p99_ms", "kv_host_restore_p99_ms",
         "disagg_tpot_win", "shared_tpot_p99_ms",
         "bert_mfu", "resnet_mfu",
         "image_decode_mbps_decoded", "image_budget_images_per_sec",
@@ -3010,6 +3266,7 @@ def build_headline(
         "ttft_p99_ms",
         "sched_spec_accept_ratio", "sched_spec_speedup",
         "sched_tokens_per_s", "sched_hi_tpot_p99_ms",
+        "kv_peer_fetch_p99_ms", "kv_reprefill_saved",
         "tpot_p99_ms", "gen_tokens_per_s",
         "disagg_tpot_p99_ms", "affinity_reprefill_saved",
         "recovery_p99_s", "recovery_p50_s",

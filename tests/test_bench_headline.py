@@ -146,6 +146,37 @@ FULL_CHAOS_BLOCK = {
 }
 
 
+FULL_KV_BLOCK = {
+    "kv_model": "gpt-tiny",
+    "kv_page_size": 4,
+    "kv_prefill_chunk": 8,
+    "kv_host_bytes": 33554432,
+    "kv_host_sessions": 12,
+    "kv_host_rounds": 5,
+    "kv_host_prefix_tokens": 40,
+    "kv_host_device_pages": 64,
+    "kv_tiered_prefilled_tokens": 672,
+    "kv_flat_prefilled_tokens": 2400,
+    "kv_reprefill_saved": 0.72,
+    "kv_host_demotions": 55,
+    "kv_host_restores": 48,
+    "kv_host_restore_p50_ms": 2.1,
+    "kv_host_restore_p99_ms": 4.8,
+    "kv_host_reprefill_p50_ms": 9.3,
+    "kv_host_reprefill_p99_ms": 14.2,
+    "kv_restore_identical": True,
+    "kv_peer_prompts": 16,
+    "kv_peer_prefix_tokens": 96,
+    "kv_peer_fetches_ok": 16,
+    "kv_peer_fetch_p50_ms": 2.8,
+    "kv_peer_fetch_p99_ms": 5.1,
+    "kv_peer_reprefill_p50_ms": 11.7,
+    "kv_peer_reprefill_p99_ms": 17.9,
+    "kv_peer_fetch_identical": True,
+    "kv_peer_ttft_win": 3.51,
+}
+
+
 FULL_RECOVERY_BLOCK = {
     "recovery_workers": 4,
     "recovery_min_replicas": 2,
@@ -216,7 +247,7 @@ def test_headline_is_one_json_line_under_the_ceiling():
         _detail(FULL_EXTRA), FULL_IMAGE_BLOCK, "BENCH_DETAIL_test.json",
         FULL_SERVING_BLOCK, FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK,
         FULL_GATEWAY_BLOCK, FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK,
-        FULL_SCHED_BLOCK,
+        FULL_SCHED_BLOCK, FULL_KV_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -288,6 +319,18 @@ def test_headline_is_one_json_line_under_the_ceiling():
     assert "sched_spec_identical" not in parsed["extra"]
     assert "sched_lo_tpot_p99_ms" not in parsed["extra"]
     assert "sched_vs_issue7_floor" not in parsed["extra"]
+    # ISSUE-17 KV-economy acceptance keys: the re-prefill fraction the
+    # host tier saved (judged against the PR 14 affinity baseline 0.6),
+    # and the peer-fetch vs re-prefill TTFT p99 pair
+    assert parsed["extra"]["kv_reprefill_saved"] == 0.72
+    assert parsed["extra"]["kv_host_restore_p99_ms"] == 4.8
+    assert parsed["extra"]["kv_peer_fetch_p99_ms"] == 5.1
+    assert parsed["extra"]["kv_peer_reprefill_p99_ms"] == 17.9
+    # ...the tier accounting and bit-identity flags stay in the detail
+    assert "kv_host_demotions" not in parsed["extra"]
+    assert "kv_tiered_prefilled_tokens" not in parsed["extra"]
+    assert "kv_restore_identical" not in parsed["extra"]
+    assert "kv_peer_ttft_win" not in parsed["extra"]
 
 
 def test_headline_degrades_instead_of_exceeding_ceiling():
@@ -299,6 +342,7 @@ def test_headline_degrades_instead_of_exceeding_ceiling():
         _detail(fat), FULL_IMAGE_BLOCK, None, FULL_SERVING_BLOCK,
         FULL_RECOVERY_BLOCK, FULL_GEN_SERVING_BLOCK, FULL_GATEWAY_BLOCK,
         FULL_CHAOS_BLOCK, FULL_DISAGG_BLOCK, FULL_SCHED_BLOCK,
+        FULL_KV_BLOCK,
     )
     assert "\n" not in line
     assert len(line) <= bench.HEADLINE_MAX_CHARS
@@ -319,6 +363,7 @@ def test_headline_without_image_block():
     assert "chaos_failed_requests" not in parsed["extra"]
     assert "affinity_reprefill_saved" not in parsed["extra"]
     assert "sched_hi_tpot_p99_ms" not in parsed["extra"]
+    assert "kv_reprefill_saved" not in parsed["extra"]
     assert len(line) <= bench.HEADLINE_MAX_CHARS
 
 
@@ -344,5 +389,7 @@ def test_serving_keys_in_drop_order():
                 "shared_tpot_p99_ms", "disagg_tpot_win",
                 "sched_hi_tpot_p99_ms", "sched_hi_tpot_p99_ms_fifo",
                 "sched_preemptions", "sched_tokens_per_s",
-                "sched_spec_speedup", "sched_spec_accept_ratio"):
+                "sched_spec_speedup", "sched_spec_accept_ratio",
+                "kv_reprefill_saved", "kv_host_restore_p99_ms",
+                "kv_peer_fetch_p99_ms", "kv_peer_reprefill_p99_ms"):
         assert f'"{key}"' in src, f"{key} missing from build_headline"

@@ -1,0 +1,699 @@
+"""The global KV economy (ISSUE 17): tiered prefix cache
+(device -> host -> peer) plus the gateway cache directory.
+
+Pins, in tier order:
+
+- **host tier**: an idle prefix demoted to host RAM and later restored
+  generates bit-identically to an uninterrupted device hit; eviction
+  accounting lands on ``tfk8s_serving_prefix_cache_evictions_total``
+  for BOTH tiers (the device counter was silently zero before this
+  PR); a corrupt host entry falls back to plain prefill and is never
+  offered twice.
+- **peer tier**: a directory-hinted pull of warm pages from another
+  replica is bit-identical at the same seeds; a digest-chain mismatch
+  (foreign or tampered K/V) is refused and degrades to plain prefill —
+  never a user-visible failure.
+- **cache directory**: a fresh report overrides the consistent-hash
+  guess; a stale owner (ejected mid-fetch) costs exactly a fallback
+  prefill, the request is still served; a serve WITHOUT ``kvTier``
+  does zero directory traffic and serves bit-identically.
+- **HostKVCache**: LRU eviction order under a byte budget.
+
+Component tests drive real tiny-GPT decode loops (the
+test_disagg_serving pattern); only pod discovery is bypassed.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import tfk8s_tpu.gateway.server as gw_mod
+from tfk8s_tpu.api.defaults import set_serve_defaults
+from tfk8s_tpu.api.types import (
+    BatchingPolicy,
+    DisaggregationPolicy,
+    KVTierPolicy,
+    ObjectMeta,
+    TPUServe,
+    TPUServeSpec,
+)
+from tfk8s_tpu.api.validation import validate_serve
+from tfk8s_tpu.client import FakeClientset
+from tfk8s_tpu.gateway.server import GatewayServer
+from tfk8s_tpu.runtime.handoff import HandoffError, KVHandoffBuffer
+from tfk8s_tpu.runtime.kvtier import CacheDirectory, HostKVCache, fetch_prefix
+from tfk8s_tpu.runtime.paging import prefix_digest_chain
+from tfk8s_tpu.runtime.server import DecodeLoopExecutor, PagedGptDecoder
+from tfk8s_tpu.trainer.serve_controller import _serve_version, render_serve_pod
+from tfk8s_tpu.utils.logging import Metrics
+
+PAGE = 8
+
+
+def tokens(n, seed=0, hi=64):
+    return np.random.default_rng(seed).integers(1, hi, size=n).astype(np.int32)
+
+
+def _make_exec(max_pages=64, kv_host_bytes=0, kv_peer_fetch=False,
+               kv_peer_resolve=None):
+    dec = PagedGptDecoder(
+        "seed:0", slots=4, page_size=PAGE, max_pages=max_pages,
+        gen_tokens=8, size="tiny", prefill_chunk=16,
+    )
+    dec.load()
+    return DecodeLoopExecutor(
+        dec, queue_limit=32, metrics=Metrics(),
+        kv_host_bytes=kv_host_bytes, kv_peer_fetch=kv_peer_fetch,
+        kv_peer_resolve=kv_peer_resolve,
+    ).start()
+
+
+# -- HostKVCache: the byte-budget LRU (pure) ---------------------------------
+
+
+class TestHostKVCache:
+    def test_lru_eviction_order_under_byte_budget(self):
+        """SATELLITE PIN: overflow evicts oldest-first, and a ``get``
+        refreshes recency — the canonical LRU contract, on bytes."""
+        evicted = []
+        c = HostKVCache(100, on_evict=lambda k, n: evicted.append(k))
+        c.put("a", b"x" * 40, akey="ka")
+        c.put("b", b"x" * 40, akey="kb")
+        assert c.get("a") is not None   # refresh: "b" is now the oldest
+        c.put("c", b"x" * 40, akey="kc")
+        assert evicted == ["b"]
+        assert c.has("a") and c.has("c") and not c.has("b")
+        assert c.bytes_used == 80
+        assert c.stats()["evictions"] == 1
+        c.put("d", b"x" * 90, akey="kd")  # displaces BOTH survivors
+        assert evicted == ["b", "a", "c"]
+        assert c.akeys() == ["kd"]
+
+    def test_oversized_entry_refused_not_thrashed(self):
+        c = HostKVCache(64)
+        assert not c.put("big", b"x" * 65, akey="kb")
+        assert len(c) == 0 and c.bytes_used == 0
+
+    def test_has_does_not_refresh_lru(self):
+        c = HostKVCache(80)
+        c.put("a", b"x" * 40, akey="ka")
+        c.put("b", b"x" * 40, akey="kb")
+        assert c.has("a")               # membership probe, not a touch
+        c.put("c", b"x" * 40, akey="kc")
+        assert not c.has("a")           # "a" was still the LRU victim
+
+    def test_discard_releases_bytes(self):
+        c = HostKVCache(100)
+        c.put("a", b"x" * 40, akey="ka")
+        c.discard("a")
+        assert c.bytes_used == 0 and not c.has("a")
+
+
+# -- host tier: demote on device eviction, restore on re-hit -----------------
+
+
+@pytest.fixture(scope="module")
+def tight():
+    """One executor with a TIGHT device pool (evictions are routine) and
+    a roomy host tier behind it, plus a roomy reference executor over
+    the same seed:0 params for uninterrupted-generation baselines."""
+    ex = _make_exec(max_pages=16, kv_host_bytes=8 << 20)
+    ref = _make_exec(max_pages=64)
+    yield ex, ref
+    ex.drain(10)
+    ref.drain(10)
+
+
+def _churn(ex, n, seed0, plen=PAGE * 3):
+    """Distinct multi-page prompts that fill and roll the device cache
+    (each registers ~2 idle pages; a 16-page pool runs dry fast)."""
+    for i in range(n):
+        ex.submit({"tokens": tokens(plen, seed=seed0 + i), "gen_tokens": 4},
+                  timeout=30)
+
+
+class TestHostTier:
+    def test_demote_then_restore_is_bit_identical(self, tight):
+        """ACCEPTANCE PIN: evict-to-host + restore-from-host generates
+        the same tokens as an uninterrupted device run — the restore
+        rides the handoff import path, a lossless byte round trip."""
+        ex, ref = tight
+        prompt = tokens(PAGE * 3, seed=500)
+        payload = {"tokens": prompt, "gen_tokens": 6}
+        want = ref.submit(payload, timeout=30)["tokens"]
+        assert ex.submit(payload, timeout=30)["tokens"] == want
+        demotions0 = ex.debug_state()["kv_host"]["demotions"]
+        _churn(ex, 8, seed0=510)  # roll the 16-page pool several times
+        st = ex.debug_state()
+        assert st["kv_host"]["demotions"] > demotions0, (
+            "churn on a 16-page pool must demote idle prefixes to host"
+        )
+        restores0 = st["kv_host"]["restores"]
+        got = ex.submit(payload, timeout=30)["tokens"]
+        assert got == want, "host-restored generation diverged"
+        st = ex.debug_state()
+        assert st["kv_host"]["restores"] > restores0, (
+            "the re-hit must land via a host restore, not a re-prefill"
+        )
+
+    def test_eviction_counters_on_both_tiers(self, tight):
+        """SATELLITE PIN (the zero-accounting bugfix): device evictions
+        now count — on the allocator, in /debug/state, and on
+        ``tfk8s_serving_prefix_cache_evictions_total{tier=device}``.
+        The host tier's own LRU evictions share the counter name under
+        ``tier=host``."""
+        ex, _ = tight
+        _churn(ex, 4, seed0=560)
+        st = ex.debug_state()
+        assert st["prefix_cache"]["evictions_device"] > 0
+        dev = ex.metrics.get_counter(
+            "tfk8s_serving_prefix_cache_evictions_total", {"tier": "device"}
+        )
+        assert dev == float(st["prefix_cache"]["evictions_device"])
+        assert ex.metrics.get_counter(
+            "tfk8s_serving_kv_host_ops_total", {"op": "demote"}
+        ) == float(st["kv_host"]["demotions"])
+
+    def test_host_tier_evictions_counted(self):
+        """tier=host on the shared eviction counter, via the executor's
+        on_evict wiring (not a hand-rolled callback)."""
+        ex = _make_exec(max_pages=16, kv_host_bytes=64 << 20)
+        try:
+            _churn(ex, 10, seed0=600)
+            entries = ex._kv_host._entries
+            assert entries, "churn must have demoted chains to host"
+            nbytes = max(len(w) for w, _a, _s in entries.values())
+            # shrink the budget to ~2 entries, then keep demoting: the
+            # LRU must overflow through the executor's on_evict hook
+            ex._kv_host.capacity_bytes = int(2.5 * nbytes)
+            _churn(ex, 8, seed0=640)
+            host = ex.debug_state()["kv_host"]
+            assert host["evictions"] > 0, (
+                "a ~2-entry host budget must overflow under churn"
+            )
+            assert ex.metrics.get_counter(
+                "tfk8s_serving_prefix_cache_evictions_total",
+                {"tier": "host"},
+            ) == float(host["evictions"])
+        finally:
+            ex.drain(10)
+
+    def test_corrupt_host_entry_falls_back_and_is_dropped(self, tight):
+        """Failure-matrix row: a host entry that fails verification on
+        restore costs a plain prefill (correct tokens), counts
+        ``op=restore_failed``, and is discarded — never offered twice."""
+        ex, ref = tight
+        prompt = tokens(PAGE * 3, seed=700)
+        payload = {"tokens": prompt, "gen_tokens": 6}
+        want = ref.submit(payload, timeout=30)["tokens"]
+        ex.submit(payload, timeout=30)
+        _churn(ex, 8, seed0=710)  # demote the chain to host
+        digests = prefix_digest_chain(
+            [int(t) for t in prompt], PAGE, len(prompt) // PAGE
+        )
+        entries = ex._kv_host._entries
+        tampered = []
+        for key in digests:
+            if key in entries:
+                wire, akey, checksum = entries[key]
+                # flip K/V payload bytes but keep the STALE checksum —
+                # exactly what a host-RAM bit flip looks like
+                entries[key] = (wire[:-3] + b"\xff\xff\xff", akey, checksum)
+                tampered.append(key)
+        assert tampered, "churn should have demoted the pinned chain"
+        got = ex.submit(payload, timeout=30)["tokens"]
+        assert got == want, "fallback prefill after corrupt restore diverged"
+        assert (ex.metrics.get_counter(
+            "tfk8s_serving_kv_host_ops_total", {"op": "restore_failed"}
+        ) or 0) > 0
+        import hashlib
+
+        for key in tampered:
+            if ex._kv_host.has(key):  # re-demoted since: must be clean
+                w, _a, s = ex._kv_host._entries[key]
+                assert hashlib.sha256(w).digest() == s
+
+
+    def test_absent_policy_means_no_host_tier(self):
+        """ACCEPTANCE PIN: without kvTier the executor has no host
+        cache, no demotions, and /debug/state shows the tier off —
+        the serving path is the pre-kvtier one bit for bit."""
+        ex = _make_exec(max_pages=16)
+        try:
+            _churn(ex, 10, seed0=800)
+            st = ex.debug_state()
+            assert st["kv_host"] is None
+            assert ex._kv_host is None
+            # eviction accounting still works (the bugfix is unconditional)
+            assert st["prefix_cache"]["evictions_device"] > 0
+        finally:
+            ex.drain(10)
+
+
+# -- peer tier: directory-hinted warm-page pull ------------------------------
+
+
+@pytest.fixture(scope="module")
+def peers():
+    """Replica A (warm source) and replica B (peer fetch on), resolving
+    each other through a plain dict — the registry seam."""
+    registry = {}
+    a = _make_exec(kv_host_bytes=8 << 20, kv_peer_fetch=True,
+                   kv_peer_resolve=registry.get)
+    b = _make_exec(kv_host_bytes=8 << 20, kv_peer_fetch=True,
+                   kv_peer_resolve=registry.get)
+    registry["A"] = a
+    registry["B"] = b
+    yield registry, a, b
+    a.drain(10)
+    b.drain(10)
+
+
+class TestPeerTier:
+    def test_peer_fetch_is_bit_identical(self, peers):
+        """ACCEPTANCE PIN: B pulling A's warm pages generates the same
+        tokens as A's own (device-hit) generation at the same seeds,
+        and B's TTFT path skipped the prefix prefill (a prefix-cache
+        hit, served by A)."""
+        _, a, b = peers
+        prompt = tokens(PAGE * 3, seed=900)
+        payload = {"tokens": prompt, "gen_tokens": 6}
+        want = a.submit(payload, timeout=30)["tokens"]  # warms A
+        serves0 = a.kv_peer_serves
+        hits0 = b.debug_state()["prefix_cache"]["hits"]
+        got = b.submit(dict(payload), timeout=30, kv_peer="A")["tokens"]
+        assert got == want, "peer-fetched generation diverged"
+        assert a.kv_peer_serves == serves0 + 1
+        assert b.debug_state()["prefix_cache"]["hits"] == hits0 + 1
+        assert b.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "ok"}
+        ) == 1.0
+
+    def test_digest_tamper_refused(self, peers):
+        """A peer export whose digest chain does not match the
+        REQUESTING prompt — self-consistent but foreign K/V — is
+        refused before import; the request still serves correct tokens
+        via plain prefill (outcome=fallback)."""
+        registry, a, b = peers
+
+        class _ForeignPeer:
+            def export_prefix(self, toks):
+                other = [int(t) for t in tokens(PAGE * 2, seed=911)]
+                return a.export_prefix(other) or self._warm(other)
+
+            def _warm(self, other):
+                a.submit({"tokens": other, "gen_tokens": 2}, timeout=30)
+                return a.export_prefix(other)
+
+        registry["F"] = _ForeignPeer()
+        prompt = tokens(PAGE * 2, seed=912)
+        payload = {"tokens": prompt, "gen_tokens": 6}
+        want = a.submit(dict(payload), timeout=30)["tokens"]
+        fb0 = b.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "fallback"}
+        ) or 0
+        got = b.submit(dict(payload), timeout=30, kv_peer="F")["tokens"]
+        assert got == want
+        assert b.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "fallback"}
+        ) == fb0 + 1
+
+    def test_vanished_peer_falls_back(self, peers):
+        """The hint names a replica that no longer resolves: plain
+        prefill, typed fallback accounting, request served."""
+        _, a, b = peers
+        prompt = tokens(PAGE * 2, seed=920)
+        payload = {"tokens": prompt, "gen_tokens": 4}
+        want = a.submit(dict(payload), timeout=30)["tokens"]
+        fb0 = b.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "fallback"}
+        ) or 0
+        got = b.submit(dict(payload), timeout=30, kv_peer="GONE")["tokens"]
+        assert got == want
+        assert b.metrics.get_counter(
+            "tfk8s_serving_kv_peer_fetches_total", {"outcome": "fallback"}
+        ) == fb0 + 1
+
+    def test_fetch_prefix_verifies_chain(self, peers):
+        """The identity gate in isolation: fetch_prefix refuses a
+        self-consistent buffer whose recomputed chain differs from the
+        REQUESTER's prompt — a lying peer cannot plant foreign K/V."""
+        _, a, _ = peers
+        warm = [int(t) for t in tokens(PAGE * 2, seed=930)]
+        a.submit({"tokens": warm, "gen_tokens": 2}, timeout=30)
+        buf = a.export_prefix(warm)
+        assert isinstance(buf, KVHandoffBuffer)
+
+        class _LyingPeer:
+            # always serves the warm buffer, whatever was asked for
+            def export_prefix(self, toks):
+                return a.export_prefix(warm)
+
+        other = [int(t) for t in tokens(PAGE * 2, seed=931)]
+        with pytest.raises(HandoffError, match="foreign"):
+            fetch_prefix({"L": _LyingPeer()}.get, "L", other)
+        # an honest peer that never held the prefix refuses earlier
+        with pytest.raises(HandoffError, match="no prefix"):
+            fetch_prefix({"A": a}.get, "A", other)
+        # and the happy path round-trips verified
+        got = fetch_prefix({"A": a}.get, "A", warm)
+        assert got.digests == buf.digests
+
+
+# -- the cache directory (pure) ----------------------------------------------
+
+
+class TestCacheDirectory:
+    def _dir(self, ttl=5.0):
+        clk = {"t": 100.0}
+        d = CacheDirectory(ttl_s=ttl, clock=lambda: clk["t"])
+        return d, clk
+
+    def test_fresh_hit_stale_and_miss(self):
+        d, clk = self._dir()
+        d.report("r1", {"digests": ["dg-a"], "host": None,
+                        "prefix_cache": {}})
+        assert d.lookup("dg-a") == ("r1", "hit")
+        assert d.lookup("dg-zz") == (None, "miss")
+        clk["t"] += 6.0  # past ttl: the entry is routing noise now
+        assert d.lookup("dg-a") == (None, "stale")
+        assert d.describe()["lookups"] == {"hit": 1, "miss": 1, "stale": 1}
+
+    def test_tie_breaks_freshest_then_lexicographic(self):
+        d, clk = self._dir()
+        d.report("r-b", {"digests": ["dg"], "host": None, "prefix_cache": {}})
+        clk["t"] += 1.0
+        d.report("r-a", {"digests": ["dg"], "host": None, "prefix_cache": {}})
+        d.report("r-c", {"digests": ["dg"], "host": None, "prefix_cache": {}})
+        # r-a and r-c share the freshest stamp; lexicographic wins
+        assert d.owner_of("dg") == "r-a"
+
+    def test_should_poll_throttles_to_half_ttl(self):
+        d, clk = self._dir(ttl=4.0)
+        assert d.should_poll()
+        assert not d.should_poll()
+        clk["t"] += 2.0
+        assert d.should_poll()
+
+    def test_forget_and_none_report_drop_the_replica(self):
+        d, _ = self._dir()
+        d.report("r1", {"digests": ["dg"], "host": None, "prefix_cache": {}})
+        d.report("r1", None)
+        assert d.lookup("dg")[1] == "miss"
+        d.report("r2", {"digests": ["dg"], "host": None, "prefix_cache": {}})
+        d.forget("r2")
+        assert d.owner_of("dg") is None
+
+
+# -- the gateway: directory-overridden routing -------------------------------
+
+
+@pytest.fixture
+def gw():
+    cs = FakeClientset()
+    metrics = Metrics()
+    server = GatewayServer(cs, port=0, metrics=metrics)
+    server.serve_background()
+    yield cs, server, metrics
+    server.shutdown()
+    server.server_close()
+
+
+def make_kvtier_state(cs, server, name, prefill_keys, decode_keys,
+                      kv_tier=True):
+    spec = TPUServeSpec(
+        task="gpt", checkpoint="seed:0",
+        batching=BatchingPolicy(
+            max_batch_size=4, batch_timeout_ms=2.0, queue_limit=64,
+            page_size=PAGE, max_pages=64,
+        ),
+        disaggregation=DisaggregationPolicy(
+            prefill_replicas=len(prefill_keys),
+            decode_replicas=len(decode_keys),
+        ),
+    )
+    if kv_tier:
+        spec.kv_tier = KVTierPolicy(host_bytes=8 << 20, peer_fetch=True)
+    cs.tpuserves().create(TPUServe(metadata=ObjectMeta(name=name), spec=spec))
+    state = server.state_for("default", name)
+    for i, key in enumerate(prefill_keys):
+        state.prefill.observe(key, float(i) * 0.01)
+    for i, key in enumerate(decode_keys):
+        state.decode.observe(key, float(i) * 0.01)
+    return state
+
+
+@pytest.fixture(scope="module")
+def kvfleet():
+    """Two prefill replicas + one decode replica, host+peer tiers on,
+    resolving peers through the module registry the gateway tests also
+    monkeypatch into ``lookup_replica``."""
+    execs = {}
+    execs["default/p-a"] = _make_exec(
+        kv_host_bytes=8 << 20, kv_peer_fetch=True,
+        kv_peer_resolve=execs.get,
+    )
+    execs["default/p-b"] = _make_exec(
+        kv_host_bytes=8 << 20, kv_peer_fetch=True,
+        kv_peer_resolve=execs.get,
+    )
+    execs["default/d-x"] = _make_exec()
+    yield execs
+    for ex in execs.values():
+        ex.drain(10)
+
+
+class TestDirectoryGateway:
+    def test_directory_hit_overrides_the_ring(self, gw, kvfleet,
+                                              monkeypatch):
+        """ACCEPTANCE PIN: the prompt's warm owner wins the pick even
+        when the consistent hash owns the key elsewhere — warm replica
+        cache-hits on turn 2 REGARDLESS of which replica the ring would
+        choose, and the lookup lands ``outcome=hit``."""
+        cs, server, metrics = gw
+        monkeypatch.setattr(gw_mod, "lookup_replica", kvfleet.get)
+        state = make_kvtier_state(
+            cs, server, "kvd", ["default/p-a", "default/p-b"],
+            ["default/d-x"],
+        )
+        assert state.kv_dir is not None
+        prompt = tokens(PAGE * 2, seed=1000)
+        payload = {"tokens": [int(t) for t in prompt], "gen_tokens": 4}
+        # warm p-a OUT OF BAND (the ring may own this key on p-b)
+        warm = kvfleet["default/p-a"]
+        want_first = warm.submit_prefill(dict(payload), timeout=30)
+        del want_first
+        # force a fresh directory sweep on the next dispatch
+        state.kv_dir._last_poll = float("-inf")
+        hits_a0 = warm.debug_state()["prefix_cache"]["hits"]
+        state.prefill.observe("default/p-a", 0.0)
+        state.prefill.observe("default/p-b", 0.0)
+        state.decode.observe("default/d-x", 0.0)
+        out = server.dispatch("default", "kvd", "default", payload, 20.0)
+        assert out["tokens"]
+        assert metrics.get_counter("tfk8s_gateway_kv_directory_total", {
+            "serve": "default/kvd", "outcome": "hit",
+        }) >= 1.0
+        assert warm.debug_state()["prefix_cache"]["hits"] == hits_a0 + 1, (
+            "the directory owner must take the prefill (device cache hit)"
+        )
+
+    def test_stale_owner_ejected_midfetch_still_serves(self, gw, kvfleet,
+                                                       monkeypatch):
+        """SATELLITE PIN (directory staleness): the directory names an
+        owner that was ejected between the report and the pick. The
+        pick skips it (not routable), the survivor's peer fetch can't
+        resolve it, and the request is STILL served — a wrong directory
+        entry costs a fallback prefill, never a failure."""
+        cs, server, metrics = gw
+        fleet = dict(kvfleet)
+        monkeypatch.setattr(gw_mod, "lookup_replica", fleet.get)
+        state = make_kvtier_state(
+            cs, server, "kvs", ["default/p-a", "default/p-b"],
+            ["default/d-x"],
+        )
+        prompt = tokens(PAGE * 2, seed=1100)
+        payload = {"tokens": [int(t) for t in prompt], "gen_tokens": 4}
+        # the baseline ALSO warms p-a (the replica about to vanish) —
+        # deliberately not d-x, which must stay cold for this prompt or
+        # the directory would legitimately find the warm decode replica
+        # and peer-fetch from it instead of falling back
+        want = kvfleet["default/p-a"].submit(dict(payload), timeout=30)["tokens"]
+        state.kv_dir._last_poll = float("-inf")
+        state.prefill.observe("default/p-a", 0.0)
+        state.prefill.observe("default/p-b", 0.0)
+        state.kv_dir.report(
+            "default/p-a", kvfleet["default/p-a"].kv_digest_report()
+        )
+        # ...then eject it mid-flight: gone from the route table, the
+        # gateway registry, AND the peer-resolve registry (the fixture
+        # dict IS the resolve seam — restored afterwards)
+        state.prefill.remove("default/p-a")
+        del fleet["default/p-a"]
+        gone = kvfleet.pop("default/p-a")
+        try:
+            state.prefill.observe("default/p-b", 0.0)
+            state.decode.observe("default/d-x", 0.0)
+            fb0 = kvfleet["default/p-b"].metrics.get_counter(
+                "tfk8s_serving_kv_peer_fetches_total",
+                {"outcome": "fallback"},
+            ) or 0
+            out = server.dispatch("default", "kvs", "default", payload, 20.0)
+            assert out["tokens"] == want, "fallback prefill must still serve"
+            assert kvfleet["default/p-b"].metrics.get_counter(
+                "tfk8s_serving_kv_peer_fetches_total",
+                {"outcome": "fallback"},
+            ) == fb0 + 1, "the survivor's peer fetch must degrade, not fail"
+        finally:
+            kvfleet["default/p-a"] = gone
+
+    def test_absent_policy_zero_directory_traffic(self, gw, kvfleet,
+                                                  monkeypatch):
+        """ACCEPTANCE PIN: no ``kvTier`` block -> ``state.kv_dir`` is
+        None, no replica is ever polled for a digest report, and no
+        directory metric series exists."""
+        cs, server, metrics = gw
+        polled = []
+        fleet = dict(kvfleet)
+
+        class _Spy:
+            def __init__(self, ex):
+                self._ex = ex
+
+            def __getattr__(self, name):
+                if name == "kv_digest_report":
+                    polled.append(name)
+                return getattr(self._ex, name)
+
+        fleet["default/p-a"] = _Spy(kvfleet["default/p-a"])
+        monkeypatch.setattr(gw_mod, "lookup_replica", fleet.get)
+        state = make_kvtier_state(
+            cs, server, "kvoff", ["default/p-a"], ["default/d-x"],
+            kv_tier=False,
+        )
+        assert state.kv_dir is None
+        prompt = tokens(PAGE * 2, seed=1200)
+        out = server.dispatch(
+            "default", "kvoff", "default",
+            {"tokens": [int(t) for t in prompt], "gen_tokens": 4}, 20.0,
+        )
+        assert out["tokens"]
+        assert polled == [], "kvTier absent must mean zero directory polls"
+        assert metrics.get_counter("tfk8s_gateway_kv_directory_total", {
+            "serve": "default/kvoff", "outcome": "hit",
+        }) is None
+
+    def test_debug_routes_shows_directory_and_host_occupancy(
+        self, gw, kvfleet, monkeypatch
+    ):
+        """SATELLITE PIN: /debug/routes renders the kv_directory block —
+        per-replica digest counts, host-tier occupancy (bytes, cached
+        prefixes, demotions/restores), freshness, lookup counters."""
+        import http.client
+
+        cs, server, _ = gw
+        monkeypatch.setattr(gw_mod, "lookup_replica", kvfleet.get)
+        state = make_kvtier_state(
+            cs, server, "kvdbg", ["default/p-a"], ["default/d-x"],
+        )
+        prompt = tokens(PAGE * 2, seed=1300)
+        state.kv_dir._last_poll = float("-inf")
+        state.prefill.observe("default/p-a", 0.0)
+        state.decode.observe("default/d-x", 0.0)
+        server.dispatch(
+            "default", "kvdbg", "default",
+            {"tokens": [int(t) for t in prompt], "gen_tokens": 4}, 20.0,
+        )
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/debug/routes")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        finally:
+            conn.close()
+        kv = body["serves"]["default/kvdbg"]["kv_directory"]
+        row = kv["replicas"]["default/p-a"]
+        assert row["digests"] > 0 and row["fresh"]
+        host = row["host"]
+        assert {"bytes", "capacity_bytes", "cached_prefixes",
+                "demotions", "restores"} <= set(host)
+        assert set(kv["lookups"]) == {"hit", "miss", "stale"}
+
+
+# -- API + controller rendering ----------------------------------------------
+
+
+def make_kv_serve(name="kv", task="gpt", **kw):
+    return TPUServe(
+        metadata=ObjectMeta(name=name),
+        spec=TPUServeSpec(
+            task=task, checkpoint="seed:0",
+            batching=BatchingPolicy(page_size=PAGE, max_pages=64),
+            kv_tier=KVTierPolicy(**kw),
+        ),
+    )
+
+
+class TestKVTierAPI:
+    def test_non_generative_task_refused(self):
+        errs = validate_serve(set_serve_defaults(make_kv_serve(task="echo")))
+        assert any("kvTier" in e and "generative" in e for e in errs)
+
+    def test_negative_host_bytes_refused(self):
+        errs = validate_serve(set_serve_defaults(
+            make_kv_serve(host_bytes=-1)
+        ))
+        assert any("kvTier.hostBytes" in e for e in errs)
+
+    def test_nonpositive_ttl_refused(self):
+        errs = validate_serve(set_serve_defaults(
+            make_kv_serve(directory_ttl_s=0.0)
+        ))
+        assert any("kvTier.directoryTtlS" in e for e in errs)
+
+    def test_defaults_validate_clean(self):
+        assert validate_serve(set_serve_defaults(make_kv_serve())) == []
+
+    def test_policy_rolls_the_template_hash(self):
+        """Knob changes roll the pods: the kvTier block is part of the
+        serve template version."""
+        base = set_serve_defaults(make_kv_serve())
+        bare = set_serve_defaults(TPUServe(
+            metadata=ObjectMeta(name="kv"),
+            spec=TPUServeSpec(
+                task="gpt", checkpoint="seed:0",
+                batching=BatchingPolicy(page_size=PAGE, max_pages=64),
+            ),
+        ))
+        v0 = _serve_version(bare)
+        v1 = _serve_version(base)
+        assert v0 != v1
+        grown = set_serve_defaults(make_kv_serve(host_bytes=128 << 20))
+        assert _serve_version(grown) != v1
+
+    def test_env_rendering(self):
+        """The executor reads the policy via env: TFK8S_KV_HOST_BYTES
+        and TFK8S_KV_PEER_FETCH rendered onto every serve pod; ABSENT
+        policy renders neither (bit-identical serving)."""
+        serve = set_serve_defaults(
+            make_kv_serve(host_bytes=32 << 20, peer_fetch=False)
+        )
+        pod = render_serve_pod(serve, _serve_version(serve), 0)
+        env = pod.spec.containers[0].env
+        assert env["TFK8S_KV_HOST_BYTES"] == str(32 << 20)
+        assert env["TFK8S_KV_PEER_FETCH"] == "0"
+        bare = set_serve_defaults(TPUServe(
+            metadata=ObjectMeta(name="kv"),
+            spec=TPUServeSpec(
+                task="gpt", checkpoint="seed:0",
+                batching=BatchingPolicy(page_size=PAGE, max_pages=64),
+            ),
+        ))
+        env2 = render_serve_pod(
+            bare, _serve_version(bare), 0
+        ).spec.containers[0].env
+        assert "TFK8S_KV_HOST_BYTES" not in env2
+        assert "TFK8S_KV_PEER_FETCH" not in env2

@@ -94,6 +94,13 @@ def test_default_scope_covers_hotpath_counters():
         "tfk8s_sched_restores_total": False,
         "tfk8s_sched_queue_depth": False,
         "tfk8s_sched_spec_accept_ratio": False,
+        # ISSUE-17 KV-economy series: the kv_economy bench arm and the
+        # tier/directory tests key off these exact names; the evictions
+        # counter is the fixed zero-accounting bug (tier=device|host)
+        "tfk8s_serving_prefix_cache_evictions_total": False,
+        "tfk8s_serving_kv_host_ops_total": False,
+        "tfk8s_serving_kv_peer_fetches_total": False,
+        "tfk8s_gateway_kv_directory_total": False,
     }
     for root in default_paths():
         if os.path.isfile(root):

@@ -123,6 +123,15 @@ FAST_GATES = [
      "TestPackedSampling.test_sampled_stream_is_bit_identical_to_generate"),
     ("test_sched.py", "TestPreemption.test_single_preemption_is_bit_identical"),
     ("test_sched.py", "TestSpeculative.test_speculative_is_token_identical"),
+    # ISSUE 17 KV economy: the demote->restore bit-identity (host tier),
+    # the hinted peer pull's bit-identity, and the gateway directory's
+    # ring override must stay gated in tier-1
+    ("test_kv_tier.py",
+     "TestHostTier.test_demote_then_restore_is_bit_identical"),
+    ("test_kv_tier.py",
+     "TestPeerTier.test_peer_fetch_is_bit_identical"),
+    ("test_kv_tier.py",
+     "TestDirectoryGateway.test_directory_hit_overrides_the_ring"),
 ]
 
 

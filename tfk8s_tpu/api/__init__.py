@@ -13,6 +13,7 @@ from tfk8s_tpu.api.types import (  # noqa: F401
     DisaggregationPolicy,
     ElasticPolicy,
     JobConditionType,
+    KVTierPolicy,
     MeshSpec,
     ObjectMeta,
     OwnerReference,

@@ -490,6 +490,30 @@ class DisaggregationPolicy:
 
 
 @dataclass
+class KVTierPolicy:
+    """The KV economy (runtime/kvtier): tiered prefix-cache residency —
+    device page pool, host-RAM LRU behind it, and peer pulls over the
+    KV transport, with a gateway cache directory steering affinity
+    routing at actual cache contents. Present in the spec ⇒ each
+    replica runs a ``host_bytes``-bounded host tier (device evictions
+    demote instead of drop), the gateway polls per-replica digest
+    reports and overrides the consistent-hash guess on a fresh
+    directory hit, and a miss routed next to a warm peer pulls the
+    prefix instead of re-prefilling; absent ⇒ bit-for-bit today's
+    behavior (no demotions, no directory traffic, no peer pulls)."""
+
+    #: host-tier capacity per replica, in bytes of serialized prefix
+    #: buffers (0 disables the host tier but keeps the directory)
+    host_bytes: int = 64 << 20
+    #: pull warm prefixes from directory-advertised peers on a local miss
+    peer_fetch: bool = True
+    #: directory staleness bound — reports older than this are ignored
+    #: (a wrong entry costs only a fallback prefill, so this trades
+    #: report traffic against routing accuracy, not correctness)
+    directory_ttl_s: float = 5.0
+
+
+@dataclass
 class TenantQuota:
     """One tenant's admission budget at the gateway (gateway/admission.py).
     ``qps``/``burst`` parameterize a reservation-style token bucket
@@ -546,6 +570,10 @@ class TPUServeSpec:
     # changing pool COUNTS scales in place, but adding/removing the
     # block itself rolls the template (the pods' phase env changes)
     disaggregation: Optional[DisaggregationPolicy] = None
+    # KV economy (None = single-tier prefix cache, today's behavior);
+    # knob changes roll the template — host capacity renders into the
+    # pods' env, so the hash must see it
+    kv_tier: Optional[KVTierPolicy] = None
 
 
 @dataclass

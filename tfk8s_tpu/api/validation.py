@@ -295,6 +295,25 @@ def validate_serve(serve: TPUServe) -> List[str]:
                 f"got {d.decode_replicas}"
             )
 
+    kv = spec.kv_tier
+    if kv is not None:
+        if spec.task not in ("gpt", "t5"):
+            # the KV economy moves prompt-prefix K/V pages between
+            # tiers; only generative tasks have any
+            errs.append(
+                f"spec.kvTier: only generative tasks (gpt, t5) have a "
+                f"KV cache to tier, got task {spec.task!r}"
+            )
+        if kv.host_bytes < 0:
+            errs.append(
+                f"spec.kvTier.hostBytes: must be >= 0, got {kv.host_bytes}"
+            )
+        if kv.directory_ttl_s <= 0:
+            errs.append(
+                f"spec.kvTier.directoryTtlS: must be > 0, got "
+                f"{kv.directory_ttl_s}"
+            )
+
     ten = spec.tenancy
     if ten.enabled:
         for path, quota in [

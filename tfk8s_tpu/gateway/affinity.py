@@ -26,6 +26,17 @@ module adds the cache-aware layer UNDER the existing health machinery:
   affine choice is more than ``AFFINITY_SPILL_DEPTH`` effective
   requests deeper than the least-loaded replica — cache hits are worth
   a bounded wait, never a hot spot.
+- the **cache directory** (runtime/kvtier/directory.py, ISSUE 17) sits
+  ABOVE the ring: when a serve carries a ``KVTierPolicy``, the gateway
+  aggregates per-replica digest reports and a fresh directory hit
+  overrides the consistent-hash guess — the ring predicts where a
+  prefix SHOULD be, the directory knows where it IS (scale-ups remap
+  the ring, evictions drop entries, disagg imports warm replicas the
+  ring never chose). The override obeys its own depth bound,
+  ``DIRECTORY_SPILL_DEPTH``: slightly looser than the affine bound,
+  because a KNOWN warm cache saves a whole prefill while the ring's
+  guess only probably does — but still bounded, for the same
+  no-hot-spot reason.
 """
 
 from __future__ import annotations
@@ -44,6 +55,11 @@ VNODES = 64
 #: an affine pick spills to least-depth: a cache hit saves one prefill,
 #: not unbounded queueing behind a hot key
 AFFINITY_SPILL_DEPTH = 4.0
+#: the same bound for a cache-DIRECTORY override (runtime/kvtier): a
+#: confirmed-warm replica is worth a little more queueing than the
+#: ring's statistical guess, but a hot prefix still must not melt one
+#: replica while the rest idle
+DIRECTORY_SPILL_DEPTH = 6.0
 
 
 def _point(s: str) -> int:
@@ -149,6 +165,7 @@ class AffinityRing:
 
 __all__ = [
     "AFFINITY_SPILL_DEPTH",
+    "DIRECTORY_SPILL_DEPTH",
     "AffinityRing",
     "VNODES",
     "affinity_key_of",

@@ -46,7 +46,11 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from tfk8s_tpu.gateway import health as _health
-from tfk8s_tpu.gateway.affinity import AFFINITY_SPILL_DEPTH, AffinityRing
+from tfk8s_tpu.gateway.affinity import (
+    AFFINITY_SPILL_DEPTH,
+    DIRECTORY_SPILL_DEPTH,
+    AffinityRing,
+)
 from tfk8s_tpu.obs.trace import get_tracer
 from tfk8s_tpu.trainer.serve_controller import EMA_ALPHA
 from tfk8s_tpu.utils.logging import get_logger
@@ -185,6 +189,7 @@ class RouteTable:
         self,
         exclude: Optional[Set[str]] = None,
         affinity_key: Optional[str] = None,
+        preferred: Optional[str] = None,
     ) -> Optional[str]:
         """Least effective depth (published EMA + local in-flight +
         Suspect penalty) among fresh, non-draining, non-excluded,
@@ -200,7 +205,14 @@ class RouteTable:
         more than ``AFFINITY_SPILL_DEPTH`` effective requests deeper than
         the fleet minimum, in which case the request spills to the
         least-depth pick (warm KV is worth a bounded queue, not an
-        unbounded one)."""
+        unbounded one).
+
+        ``preferred`` is the cache directory's answer (runtime/kvtier):
+        a replica CONFIRMED to hold the prompt's prefix warm. It
+        outranks the ring's guess — route ``directory`` — under its own
+        slightly looser bound (``DIRECTORY_SPILL_DEPTH``); a
+        non-routable or overloaded preferred replica falls back to the
+        normal ring walk, costing at most a fallback prefill."""
         self.refresh()
         now = self._clock()
         probe = False
@@ -229,18 +241,32 @@ class RouteTable:
                 route = "none"
                 if affinity_key:
                     route = "spill"
-                    for cand in self._ring.candidates(affinity_key):
-                        if exclude and cand in exclude:
-                            continue
-                        e = self._entries.get(cand)
-                        if e is None or not e.health.routable(now):
-                            continue
-                        d = eff(cand)
-                        if best is None or d <= best_depth + AFFINITY_SPILL_DEPTH:
-                            best, best_depth = cand, d
-                            route = "affine"
-                        # first ROUTABLE successor decides: pin or spill
-                        break
+                    if preferred is not None and not (
+                        exclude and preferred in exclude
+                    ):
+                        e = self._entries.get(preferred)
+                        if e is not None and e.health.routable(now):
+                            d = eff(preferred)
+                            if best is None or (
+                                d <= best_depth + DIRECTORY_SPILL_DEPTH
+                            ):
+                                best, best_depth = preferred, d
+                                route = "directory"
+                    if route != "directory":
+                        for cand in self._ring.candidates(affinity_key):
+                            if exclude and cand in exclude:
+                                continue
+                            e = self._entries.get(cand)
+                            if e is None or not e.health.routable(now):
+                                continue
+                            d = eff(cand)
+                            if best is None or (
+                                d <= best_depth + AFFINITY_SPILL_DEPTH
+                            ):
+                                best, best_depth = cand, d
+                                route = "affine"
+                            # first ROUTABLE successor decides: pin/spill
+                            break
             if best is not None:
                 h = self._entries[best].health
                 if h.state == _health.EJECTED:

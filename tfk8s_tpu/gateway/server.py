@@ -54,6 +54,7 @@ from tfk8s_tpu.runtime.handoff import (
     KVTransport,
     LocalKVTransport,
 )
+from tfk8s_tpu.runtime.kvtier import CacheDirectory
 from tfk8s_tpu.runtime.server import (
     DeadlineExceeded,
     Draining,
@@ -402,7 +403,8 @@ class _ServeState:
     admission pressure signal reads the pool requests enter first."""
 
     __slots__ = ("table", "admission", "queue_limit", "fetched",
-                 "retry_budget", "prefill", "decode", "page_size")
+                 "retry_budget", "prefill", "decode", "page_size",
+                 "kv_dir")
 
     def __init__(self, table: RouteTable,
                  prefill: Optional[RouteTable] = None,
@@ -411,6 +413,9 @@ class _ServeState:
         self.prefill = prefill
         self.decode = decode
         self.page_size = 0
+        # cache directory (runtime/kvtier): present only when the serve
+        # carries a KVTierPolicy — absent means ZERO directory traffic
+        self.kv_dir: Optional[CacheDirectory] = None
         self.admission = TenantAdmission()
         self.queue_limit = 0
         self.fetched = 0.0
@@ -494,6 +499,12 @@ class GatewayServer(ThreadingHTTPServer):
             metrics.describe(
                 "tfk8s_gateway_affinity_ring_members",
                 "Replicas on the prefix-affinity consistent-hash ring.",
+            )
+            metrics.describe(
+                "tfk8s_gateway_kv_directory_total",
+                "Cache-directory lookups on the dispatch path, by "
+                "outcome (hit=fresh owner, stale=only expired reports, "
+                "miss=no replica reported the prefix).",
             )
             metrics.describe(
                 "tfk8s_disagg_handoffs_total",
@@ -631,6 +642,13 @@ class GatewayServer(ThreadingHTTPServer):
                 self._states[(namespace, name)] = state
             state.queue_limit = serve.spec.batching.queue_limit
             state.page_size = serve.spec.batching.page_size
+            kv = getattr(serve.spec, "kv_tier", None)
+            if kv is None:
+                # policy absent: no directory, no polling — the serving
+                # path is bit-identical to a pre-kvtier gateway
+                state.kv_dir = None
+            elif state.kv_dir is None:
+                state.kv_dir = CacheDirectory(ttl_s=kv.directory_ttl_s)
             state.fetched = now
         state.admission.configure(serve.spec.tenancy)
         return state
@@ -800,17 +818,36 @@ class GatewayServer(ThreadingHTTPServer):
         # turns keep their pin even as the shared history grows past the
         # first page); otherwise the page-aligned prefix digest of the
         # prompt itself (co-locates prompts sharing a system prefix)
-        akey: Optional[str] = (session or "").strip() or None
-        if akey is None:
-            raw = payload.get("tokens") if isinstance(payload, dict) else payload
-            try:
-                toks = [int(t) for t in raw] if raw is not None else []
-            except (TypeError, ValueError):
-                toks = []
-            if toks:
-                akey = affinity_key_of(toks, state.page_size)
+        raw = payload.get("tokens") if isinstance(payload, dict) else payload
+        try:
+            toks = [int(t) for t in raw] if raw is not None else []
+        except (TypeError, ValueError):
+            toks = []
+        # the digest key is ALWAYS the prompt's first-page digest (it is
+        # what replicas report to the cache directory); the ring key may
+        # be the caller's opaque session token instead
+        dkey = affinity_key_of(toks, state.page_size) if toks else None
+        akey: Optional[str] = (session or "").strip() or dkey
         if meta is not None and akey:
             meta["session"] = akey
+        # cache directory (runtime/kvtier): a fresh report naming a
+        # replica that HOLDS this prefix overrides the ring's guess; if
+        # the pick still lands elsewhere, the owner rides along as a
+        # peer-fetch hint so the prefill replica can pull the warm pages
+        # instead of recomputing them
+        kv_owner: Optional[str] = None
+        if state.kv_dir is not None and dkey is not None:
+            self._kv_directory_refresh(state)
+            kv_owner, outcome = state.kv_dir.lookup(dkey)
+            if self.metrics is not None:
+                self.metrics.inc("tfk8s_gateway_kv_directory_total", 1.0, {
+                    "serve": serve_label, "outcome": outcome,
+                })
+            if span is not None:
+                span.add_event("kv_directory.lookup", {
+                    "outcome": outcome, "owner": kv_owner or "",
+                })
+        owner = kv_owner
         release = state.admission.admit(
             tenant, state.prefill.least_depth(), state.queue_limit
         )
@@ -818,10 +855,15 @@ class GatewayServer(ThreadingHTTPServer):
             prefill_res = self._run_phase(
                 state, state.prefill, serve_label, tenant, deadline,
                 timeout, t0, span, akey,
-                lambda srv, rem: srv.submit_prefill(
+                lambda srv, rem, key: srv.submit_prefill(
                     payload, timeout=rem, traceparent=traceparent,
                     tenant=tenant, priority=priority,
+                    # hint only when the pick LOST the directory owner
+                    # (spill, owner in the decode pool, owner ejected):
+                    # a replica never peer-fetches from itself
+                    kv_peer=(owner if owner and owner != key else ""),
                 ),
+                preferred=kv_owner,
             )
             buf = prefill_res["handoff"]
             nbytes = 0
@@ -855,7 +897,7 @@ class GatewayServer(ThreadingHTTPServer):
             return self._run_phase(
                 state, state.decode, serve_label, tenant, deadline,
                 timeout, None, span, None,
-                lambda srv, rem: srv.submit_handoff(
+                lambda srv, rem, key: srv.submit_handoff(
                     buf, timeout=rem, traceparent=traceparent,
                     tenant=tenant, priority=priority,
                 ),
@@ -863,15 +905,44 @@ class GatewayServer(ThreadingHTTPServer):
         finally:
             release()
 
+    def _kv_directory_refresh(self, state: _ServeState) -> None:
+        """Pull ``kv_digest_report`` from every routable replica of the
+        serve (both phase pools — decode replicas hold imported prefixes
+        too) into the cache directory. Rate-limited by the directory's
+        own ``should_poll`` throttle (ttl/2), so the hot path amortizes
+        the sweep; a replica that vanished or predates the report API
+        simply drops out of the directory at its next TTL expiry."""
+        kv_dir = state.kv_dir
+        if kv_dir is None or not kv_dir.should_poll():
+            return
+        for _, table in state.named_tables():
+            if table is None:
+                continue
+            for key, _depth in table.targets():
+                server = lookup_replica(key)
+                report_fn = getattr(server, "kv_digest_report", None)
+                if report_fn is None:
+                    kv_dir.forget(key)
+                    continue
+                try:
+                    kv_dir.report(key, report_fn())
+                except Exception:  # noqa: BLE001 - a dying replica's
+                    # report must never fail the request being routed
+                    kv_dir.forget(key)
+
     def _run_phase(self, state: _ServeState, table: RouteTable,
                    serve_label: str, tenant: str, deadline: float,
                    timeout: float, t0: Optional[float], span,
-                   affinity_key: Optional[str], call) -> Any:
+                   affinity_key: Optional[str], call,
+                   preferred: Optional[str] = None) -> Any:
         """One phase of a disaggregated dispatch: the pick/submit/retry
         loop of :meth:`dispatch`, against ONE pool's route table.
-        ``call(server, remaining)`` performs the phase's submit; the
-        loop owns routing, outcome feedback, Draining/vanished/crash
-        re-dispatch, and the typed surfacing contract."""
+        ``call(server, remaining, key)`` performs the phase's submit;
+        the loop owns routing, outcome feedback, Draining/vanished/crash
+        re-dispatch, and the typed surfacing contract. ``preferred`` is
+        the cache directory's confirmed-warm replica, honored by the
+        pick when routable; once excluded (drain, crash) the retry walk
+        proceeds without it."""
         phase = table.phase or "serve"
         exclude: set = set()
         tried: list = []
@@ -886,7 +957,8 @@ class GatewayServer(ThreadingHTTPServer):
                 )
                 exc.tried = list(tried)
                 raise exc
-            key = table.pick(exclude, affinity_key=affinity_key)
+            key = table.pick(exclude, affinity_key=affinity_key,
+                             preferred=preferred)
             if key is None:
                 if exclude:
                     exclude = set()  # full rescan before backing off
@@ -918,7 +990,7 @@ class GatewayServer(ThreadingHTTPServer):
                         time.perf_counter() - t0, {"serve": serve_label},
                     )
                     t0 = None
-                result = call(server, remaining)
+                result = call(server, remaining, key)
                 table.report_outcome(
                     key, "ok", time.perf_counter() - submit_t0
                 )
@@ -980,5 +1052,9 @@ class GatewayServer(ThreadingHTTPServer):
                 if ring is not None:
                     block["ring"] = ring
                 entry[phase or "default"] = block
+            if st.kv_dir is not None:
+                # the KV economy's routing view: per-replica digest
+                # counts, host-tier occupancy, and lookup outcomes
+                entry["kv_directory"] = st.kv_dir.describe()
             serves[f"{ns}/{name}"] = entry
         return {"serves": serves}

@@ -105,6 +105,27 @@ class KVHandoffBuffer:
                     f"expected {rows} prompt rows"
                 )
 
+    @classmethod
+    def prefix(cls, version: str, page_size: int, tokens: List[int],
+               digests: List[str], kv: List[Any]) -> "KVHandoffBuffer":
+        """A PREFIX-resident buffer (KV tier demotion/peer export,
+        runtime/kvtier): page-aligned cached-prefix K/V with no
+        generation state attached. ``gen_budget=0`` marks it
+        non-admittable — ``submit_handoff`` refuses a zero budget, so a
+        prefix buffer can only re-enter through the warm-insert path
+        (cache adoption), never start a decode row by itself."""
+        if len(tokens) % page_size != 0:
+            raise HandoffError(
+                f"prefix buffer must be page-aligned: {len(tokens)} "
+                f"token(s) @ page_size {page_size}"
+            )
+        buf = cls(
+            version=version, page_size=page_size, tokens=list(tokens),
+            last_token=0, gen_budget=0, digests=list(digests), kv=kv,
+        )
+        buf.verify()
+        return buf
+
     # -- wire form -----------------------------------------------------------
 
     def to_bytes(self) -> bytes:

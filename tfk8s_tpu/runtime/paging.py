@@ -30,7 +30,7 @@ from __future__ import annotations
 import hashlib
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 #: reserved write target for inactive slots; never handed out
 TRASH_PAGE = 0
@@ -57,6 +57,19 @@ class OutOfPages(Exception):
     """The pool cannot cover a new request's worst-case page budget.
     Admission-time only: the caller keeps the request queued and retries
     after retirements free pages."""
+
+
+@dataclass
+class RestoreTicket:
+    """In-flight KV-tier restore (:meth:`PageAllocator.restore_begin`):
+    drawn-but-unpublished pages plus the ref-pinned resident head of the
+    chain being restored. Must be resolved by ``restore_commit`` or
+    ``restore_abort`` before the admission pass continues."""
+
+    digests: List[str]
+    start: int
+    pages: List[int]
+    pinned: List[int]
 
 
 @dataclass
@@ -118,6 +131,16 @@ class PageAllocator:
         self._tainted: set = set()
         self.prefix_hits = 0
         self.prefix_misses = 0
+        #: idle cached pages reclaimed by :meth:`_evict_idle` (the device
+        #: tier's eviction accounting — ISSUE 17 bugfix: before the KV
+        #: economy these drops were invisible)
+        self.evictions = 0
+        #: observer invoked BEFORE an idle cached page is dropped, with
+        #: ``(digest_key, page_id)`` — the page is still cache-resident
+        #: during the call so the host tier (runtime/kvtier) can demote
+        #: the whole chain it belongs to. The callback MUST NOT mutate
+        #: this allocator (it runs mid-eviction); reads are fine.
+        self.on_evict: Optional[Callable[[str, int], None]] = None
 
     # -- accounting ---------------------------------------------------------
 
@@ -161,6 +184,115 @@ class PageAllocator:
                 break
             matched.append(pid)
         return matched, len(matched) * self.page_size
+
+    def cached_chain(self, digests: Sequence[str]) -> List[int]:
+        """Resident page ids for the longest cached prefix of a digest
+        chain, WITHOUT acquiring them. The KV-tier read path: demotion
+        walks it to find what is still exportable, restore walks it to
+        find where the device tier ends."""
+        pages: List[int] = []
+        for key in digests:
+            pid = self._cache.get(key)
+            if pid is None:
+                break
+            pages.append(pid)
+        return pages
+
+    def cached_keys(self, limit: int = 0) -> List[str]:
+        """Digest keys currently in the prefix cache, LRU-oldest first
+        (the gateway cache directory's per-replica report; ``limit`` > 0
+        keeps only the most-recent tail)."""
+        keys = list(self._cache.keys())
+        return keys[-limit:] if limit > 0 else keys
+
+    def restore_begin(self, digests: Sequence[str],
+                      start: int) -> Optional["RestoreTicket"]:
+        """Phase 1 of adopting externally sourced prefix pages (host/peer
+        tier restore, runtime/kvtier): draw one page per chain position
+        ``start..len(digests)-1``, WITHOUT publishing them. The caller
+        scatters the restored K/V into ``ticket.pages``, then
+        :meth:`restore_commit` publishes them under their digests (or
+        :meth:`restore_abort` returns them untouched). The two-phase
+        shape is load-bearing: a dry free list evicts idle cached pages
+        through the normal :meth:`_evict_idle` path — whose demotion
+        callback may EXPORT any published chain — so pages holding
+        not-yet-scattered garbage must stay invisible to the cache until
+        their bytes are real. The chain's resident head is ref-pinned
+        for the ticket's lifetime so the eviction scan cannot break the
+        chain being restored; drawn pages need no pin (eviction only
+        sees the cache). Returns ``None`` — side-effect-free — when live
+        leases own the whole pool.
+
+        Accounting-neutral once committed: every drawn page becomes an
+        idle cached (evictable) page, so :meth:`available` and the
+        infallible-:meth:`extend` contract hold. A restore SHUFFLES
+        residency (displaced chains demote to host first); it never
+        destroys it."""
+        need = len(digests) - start
+        if need <= 0:
+            return None
+        pinned: List[int] = []
+        for key in digests[:start]:
+            pid = self._cache.get(key)
+            if pid is not None:
+                # touch the head: the whole chain ends up contiguous at
+                # the MRU end, aging (and demoting) as one unit
+                self._cache.move_to_end(key)
+                self._ref[pid] = self._ref.get(pid, 0) + 1
+                pinned.append(pid)
+        pages: List[int] = []
+        while len(pages) < need:
+            if not self._free:
+                if not any(
+                    not self._ref.get(p) for p in self._cache.values()
+                ):
+                    self._free.extend(pages)
+                    self._unpin(pinned)
+                    return None
+                self._evict_idle()
+            pages.append(self._free.popleft())
+        return RestoreTicket(
+            digests=list(digests), start=start, pages=pages, pinned=pinned
+        )
+
+    def restore_commit(self, ticket: "RestoreTicket") -> None:
+        """Phase 2: the K/V landed — publish the drawn pages under their
+        digests (idle cached, exactly as if a request had prefilled and
+        released them) and unpin the head."""
+        for key, pid in zip(ticket.digests[ticket.start:], ticket.pages):
+            self._cache[key] = pid
+            self._page_key[pid] = key
+        self._unpin(ticket.pinned)
+        ticket.pages = []
+        ticket.pinned = []
+
+    def restore_abort(self, ticket: "RestoreTicket") -> None:
+        """The scatter failed: return the drawn pages to the free list
+        unpublished and unpin the head. No trace remains."""
+        self._free.extend(ticket.pages)
+        self._unpin(ticket.pinned)
+        ticket.pages = []
+        ticket.pinned = []
+
+    def _unpin(self, pinned: List[int]) -> None:
+        for pid in pinned:
+            n = self._ref.get(pid, 0) - 1
+            if n > 0:
+                self._ref[pid] = n
+            else:
+                self._ref.pop(pid, None)
+
+    def discard_cached(self, keys: Sequence[str]) -> None:
+        """Unpublish idle cache entries (a failed restore rolls back the
+        pages it drew; pages shared with a live lease just lose their
+        cache identity and free at final release)."""
+        for key in keys:
+            pid = self._cache.pop(key, None)
+            if pid is None:
+                continue
+            self._page_key.pop(pid, None)
+            if not self._ref.get(pid):
+                self._free.append(pid)
 
     def register_prefix(self, tokens: Sequence[int], lease: SlotLease) -> None:
         """Publish the lease's full-page prompt prefixes into the cache
@@ -360,9 +492,14 @@ class PageAllocator:
         capacity, so an idle page must exist."""
         for key, pid in self._cache.items():
             if not self._ref.get(pid):
+                if self.on_evict is not None:
+                    # page still resident: the host tier can export the
+                    # chain this digest belongs to before it disappears
+                    self.on_evict(key, pid)
                 del self._cache[key]
                 del self._page_key[pid]
                 self._free.append(pid)
+                self.evictions += 1
                 return
         raise OutOfPages("no idle cached page to evict — accounting bug")
 
@@ -370,6 +507,7 @@ class PageAllocator:
 __all__ = [
     "OutOfPages",
     "PageAllocator",
+    "RestoreTicket",
     "SlotLease",
     "TRASH_PAGE",
     "prefix_digest_chain",

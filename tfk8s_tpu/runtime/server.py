@@ -790,6 +790,10 @@ class _GenRequest:
     # stream must be rebuilt from the immutable prompt every time)
     preempt_count: int = 0
     prompt_len: int = 0
+    # KV economy (runtime/kvtier): the gateway's cache-directory hint —
+    # a replica key believed to hold this prompt's prefix warm. Consumed
+    # (at most once) by the admission-time peer fetch; empty = no hint.
+    kv_peer: str = ""
 
     def wall(self, t: float) -> float:
         """Map a perf_counter stamp onto the wall clock."""
@@ -849,7 +853,12 @@ class DecodeLoopExecutor:
         preemption: bool = True,
         aging_s: float = 5.0,
         speculative: Any = None,
+        kv_host_bytes: int = 0,
+        kv_peer_fetch: bool = False,
+        kv_transport: Any = None,
+        kv_peer_resolve: Any = None,
     ):
+        from tfk8s_tpu.runtime.kvtier import HostKVCache
         from tfk8s_tpu.runtime.paging import PageAllocator
         from tfk8s_tpu.runtime.sched import make_scheduler
 
@@ -872,6 +881,24 @@ class DecodeLoopExecutor:
         self.allocator = PageAllocator(
             model.max_pages, model.page_size, prefix_cache=prefix_cache
         )
+        # KV economy (runtime/kvtier): the device tier's eviction hook
+        # always runs — eviction accounting is a bugfix, not a feature
+        # flag — but demotion to host only happens with a host budget
+        self.allocator.on_evict = self._kv_on_device_evict
+        self._kv_host = (
+            HostKVCache(
+                int(kv_host_bytes), on_evict=self._kv_on_host_evict
+            ) if kv_host_bytes and int(kv_host_bytes) > 0 else None
+        )
+        self._kv_peer_fetch = bool(kv_peer_fetch)
+        self._kv_transport = kv_transport
+        self._kv_resolve = kv_peer_resolve
+        # digest -> (full-page prompt ints, chain length): what the
+        # demotion path needs to rebuild a chain's tokens when one of
+        # its pages evicts (register_prefix only keeps digests)
+        self._kv_chains: Dict[str, Tuple[List[int], int]] = {}
+        self.kv_peer_serves = 0
+        self._kv_restore_ms: deque = deque(maxlen=256)
         self._cond = threading.Condition()
         # admission order is a pluggable policy (runtime/sched): FIFO is
         # the PR-7 behavior bit-identical; "priority" adds the per-class
@@ -949,6 +976,18 @@ class DecodeLoopExecutor:
             ("tfk8s_sched_spec_accept_ratio",
              "Speculative decode: accepted draft tokens / proposed, "
              "cumulative."),
+            ("tfk8s_serving_prefix_cache_evictions_total",
+             "Cached prefixes dropped by LRU pressure, by tier "
+             "(device = page pool, host = host-RAM KV cache)."),
+            ("tfk8s_serving_kv_host_ops_total",
+             "Host-tier KV cache traffic: demote (device eviction "
+             "parked the chain), restore (a later prompt re-imported "
+             "it), restore_failed (corrupt/mismatched entry dropped, "
+             "plain prefill ran)."),
+            ("tfk8s_serving_kv_peer_fetches_total",
+             "Peer-tier prefix pulls, by outcome (ok = warm pages "
+             "imported; fallback = any HandoffError, plain prefill "
+             "ran)."),
         ):
             self.metrics.describe(name, help_text)
 
@@ -1004,7 +1043,7 @@ class DecodeLoopExecutor:
 
     def submit(self, payload: Any, timeout: Optional[float] = 30.0,
                traceparent: Optional[str] = None, tenant: str = "",
-               priority: int = 0) -> Any:
+               priority: int = 0, kv_peer: str = "") -> Any:
         """Blocking request; raises Overloaded / Draining / InvalidRequest
         / RequestFailed / DeadlineExceeded — the :class:`ModelServer`
         contract. Returns ``{"tokens": [...], "version": ...}`` with the
@@ -1029,13 +1068,13 @@ class DecodeLoopExecutor:
             tokens=tokens, gen_budget=gen, enqueue_t=time.perf_counter(),
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
-            sampling=sampling,
+            sampling=sampling, kv_peer=kv_peer or "",
         )
         return self._enqueue_and_wait(req, timeout)
 
     def submit_prefill(self, payload: Any, timeout: Optional[float] = 30.0,
                        traceparent: Optional[str] = None, tenant: str = "",
-                       priority: int = 0) -> Any:
+                       priority: int = 0, kv_peer: str = "") -> Any:
         """Prefill-pool entry point (disaggregated serving): run chunked
         prefill to completion, pick the FIRST output token, export the
         warm KV, and retire — same typed contract as :meth:`submit`, but
@@ -1061,6 +1100,7 @@ class DecodeLoopExecutor:
             traceparent=traceparent or "", tenant=tenant,
             priority=int(priority), wall_start=time.time(),
             prefill_only=True, decode_budget=gen, sampling=sampling,
+            kv_peer=kv_peer or "",
         )
         return self._enqueue_and_wait(req, timeout)
 
@@ -1213,6 +1253,11 @@ class DecodeLoopExecutor:
                         req.tokens, req.handoff.gen_budget
                     )
                 else:
+                    # KV economy: climb the tiers (host restore, then a
+                    # directory-hinted peer fetch) BEFORE admit, so a
+                    # warm prefix lands as an ordinary device hit; a
+                    # no-op with the tiers off
+                    self._kv_promote_locked(req)
                     lease = self.allocator.admit(req.tokens, req.gen_budget)
             except OutOfPages:
                 if self._preemption and self._maybe_preempt_locked(req):
@@ -1331,6 +1376,280 @@ class DecodeLoopExecutor:
         self._state_dirty = True
         self._q.requeue_front(req)
         self._sched_gauges_locked()
+
+    # -- KV economy (runtime/kvtier) ----------------------------------------
+
+    def _kv_on_device_evict(self, key: str, pid: int) -> None:
+        """``PageAllocator.on_evict``: the device tier is dropping an
+        idle cached page. Always accounts the eviction (the ISSUE-17
+        bugfix — these drops used to be invisible); with a host budget,
+        demotes the longest still-resident chain through the evicting
+        page before it disappears. Runs inside ``_evict_idle`` under the
+        executor lock — reads the allocator, never mutates it."""
+        self.metrics.inc(
+            "tfk8s_serving_prefix_cache_evictions_total", 1.0,
+            {**self.labels, "tier": "device"},
+        )
+        if self._kv_host is None:
+            return
+        info = self._kv_chains.get(key)
+        if info is None:
+            return
+        from tfk8s_tpu.runtime.paging import prefix_digest_chain
+
+        toks, m = info
+        ps = self.model.page_size
+        digests = prefix_digest_chain(toks, ps, m)
+        pages = self.allocator.cached_chain(digests)
+        r = len(pages)
+        if r == 0 or self._kv_host.has(digests[r - 1]):
+            # the chain's head already evicted (a later page of an
+            # already-demoted chain), or the host holds it — either way
+            # there is nothing new to park
+            return
+        try:
+            wire = KVHandoffBuffer.prefix(
+                version=self.model.version, page_size=ps,
+                tokens=toks[:r * ps], digests=digests[:r],
+                kv=self.model.export_kv(pages),
+            ).to_bytes()
+        except HandoffError as e:
+            log.warning("kv host demotion failed, chain dropped: %s", e)
+            return
+        if self._kv_host.put(digests[r - 1], wire, akey=digests[0]):
+            self.metrics.inc(
+                "tfk8s_serving_kv_host_ops_total", 1.0,
+                {**self.labels, "op": "demote"},
+            )
+
+    def _kv_on_host_evict(self, key: str, nbytes: int) -> None:
+        """Host-tier LRU overflow: the byte budget pushed a chain out of
+        its last tier. Same eviction counter, ``tier="host"``."""
+        self.metrics.inc(
+            "tfk8s_serving_prefix_cache_evictions_total", 1.0,
+            {**self.labels, "tier": "host"},
+        )
+
+    def _kv_note_chain(self, tokens: Any) -> None:
+        """Remember the tokens behind a registered prefix chain so the
+        demotion path can rebuild (and re-hash) the chain when one of
+        its pages evicts — ``register_prefix`` itself only keeps
+        digests. Bounded: entries for chains no tier still holds are
+        pruned once the map outgrows the pool."""
+        if self._kv_host is None:
+            return
+        from tfk8s_tpu.runtime.paging import prefix_digest_chain
+
+        ps = self.model.page_size
+        m = max(len(tokens) - 1, 0) // ps  # register_prefix's k_max
+        if m == 0:
+            return
+        toks = [int(t) for t in tokens[:m * ps]]
+        for d in prefix_digest_chain(toks, ps, m):
+            prev = self._kv_chains.get(d)
+            if prev is None or prev[1] < m:
+                self._kv_chains[d] = (toks, m)
+        if len(self._kv_chains) > 16 * self.allocator.num_pages:
+            held = set(self.allocator.cached_keys())
+            self._kv_chains = {
+                d: v for d, v in self._kv_chains.items()
+                if d in held or self._kv_host.has(d)
+            }
+
+    def _kv_promote_locked(self, req: _GenRequest) -> None:
+        """Admission-time tier climb: before a request admits, pull its
+        prefix UP the tiers — host restore first (local, cheap), then a
+        directory-hinted peer fetch — so :meth:`PageAllocator.admit`
+        sees a plain device hit. Every failure shape degrades to plain
+        prefill; this method never raises. Caller holds the lock (loop
+        thread, admission pass — no step in flight)."""
+        want_peer = bool(self._kv_peer_fetch and req.kv_peer)
+        if self._kv_host is None and not want_peer:
+            return
+        from tfk8s_tpu.runtime.paging import prefix_digest_chain
+
+        peer_hint, req.kv_peer = req.kv_peer, ""  # one attempt, ever
+        tokens = req.tokens
+        ps = self.model.page_size
+        k_max = max(len(tokens) - 1, 0) // ps
+        if k_max == 0:
+            return
+        digests = prefix_digest_chain(tokens, ps, k_max)
+        d = len(self.allocator.cached_chain(digests))
+        if d >= k_max:
+            return  # full device hit already — nothing to climb for
+        if self._kv_host is not None:
+            for j in range(k_max, d, -1):
+                t0 = time.perf_counter()
+                try:
+                    # get() raises on a checksum mismatch (host-RAM
+                    # corruption) — same fallback as a failed adopt
+                    wire = self._kv_host.get(digests[j - 1])
+                    if wire is None:
+                        continue
+                    self._kv_adopt_locked(
+                        KVHandoffBuffer.from_bytes(wire), digests, d, j
+                    )
+                except HandoffError as e:
+                    # corrupt or unlandable entry: drop it (never offer
+                    # it twice) and fall through to peer/plain prefill
+                    self._kv_host.discard(digests[j - 1])
+                    self.metrics.inc(
+                        "tfk8s_serving_kv_host_ops_total", 1.0,
+                        {**self.labels, "op": "restore_failed"},
+                    )
+                    log.warning("kv host restore failed, prefilling: %s", e)
+                    break
+                self._kv_host.restores += 1
+                self._kv_restore_ms.append(
+                    (time.perf_counter() - t0) * 1000.0
+                )
+                self.metrics.inc(
+                    "tfk8s_serving_kv_host_ops_total", 1.0,
+                    {**self.labels, "op": "restore"},
+                )
+                self._kv_note_chain(tokens)
+                return
+        if want_peer:
+            from tfk8s_tpu.runtime import kvtier
+
+            try:
+                buf = kvtier.fetch_prefix(
+                    self._kv_resolve or lookup_replica, peer_hint,
+                    tokens, transport=self._kv_transport,
+                )
+                j = min(len(buf.tokens) // ps, k_max)
+                if j <= d:
+                    raise HandoffError(
+                        "peer prefix no longer than the local one"
+                    )
+                self._kv_adopt_locked(buf, digests, d, j)
+            except HandoffError as e:
+                self.metrics.inc(
+                    "tfk8s_serving_kv_peer_fetches_total", 1.0,
+                    {**self.labels, "outcome": "fallback"},
+                )
+                log.info("kv peer fetch from %s fell back to prefill: %s",
+                         peer_hint, e)
+            else:
+                self.metrics.inc(
+                    "tfk8s_serving_kv_peer_fetches_total", 1.0,
+                    {**self.labels, "outcome": "ok"},
+                )
+                self._kv_note_chain(tokens)
+
+    def _kv_adopt_locked(self, buf: KVHandoffBuffer, digests: List[str],
+                         start: int, upto: int) -> None:
+        """Warm-insert a verified prefix buffer into the idle device
+        cache: draw pages for chain positions ``start..upto-1``, scatter
+        the buffer's K/V rows into them, publish them under their
+        digests — the admission that follows sees a plain device hit
+        (same pages, same bytes: bit-identity by construction). Raises
+        :class:`HandoffError` when the buffer cannot land here."""
+        ps = self.model.page_size
+        if buf.page_size != ps:
+            raise HandoffError(
+                f"buffer page_size={buf.page_size}, replica runs {ps}"
+            )
+        if buf.version != self.model.version:
+            raise HandoffError(
+                f"buffer from {buf.version!r}, replica serves "
+                f"{self.model.version!r} — params differ"
+            )
+        if len(buf.tokens) < upto * ps:
+            raise HandoffError(
+                f"buffer covers {len(buf.tokens)} token(s), chain needs "
+                f"{upto * ps}"
+            )
+        ticket = self.allocator.restore_begin(digests[:upto], start)
+        if ticket is None:
+            raise HandoffError("live leases own the pool — cannot restore")
+        try:
+            self.model.import_kv(
+                [leaf[start * ps:upto * ps] for leaf in buf.kv],
+                ticket.pages,
+            )
+        except BaseException as e:  # noqa: BLE001 — roll back, degrade
+            self.allocator.restore_abort(ticket)
+            if isinstance(e, HandoffError):
+                raise
+            raise HandoffError(f"restore scatter failed: {e}") from e
+        self.allocator.restore_commit(ticket)
+
+    def export_prefix(self, tokens: Any) -> Optional[KVHandoffBuffer]:
+        """Peer-tier export: the longest warm prefix of ``tokens`` this
+        replica holds, as a verified prefix buffer — device chain first
+        (gathered straight from the pool), host tier second (the parked
+        wire bytes deserialize back). ``None`` when neither tier has
+        it. Called by PEER replicas through
+        :func:`tfk8s_tpu.runtime.kvtier.fetch_prefix`; the gather is
+        read-only, so a foreign-thread export never perturbs the loop."""
+        from tfk8s_tpu.runtime.paging import prefix_digest_chain
+
+        toks = [int(t) for t in tokens]
+        ps = self.model.page_size
+        k_max = max(len(toks) - 1, 0) // ps
+        if k_max == 0:
+            return None
+        digests = prefix_digest_chain(toks, ps, k_max)
+        # BOUNDED acquire, not ``with``: the caller is another replica's
+        # admission path holding ITS loop lock — two replicas hinted at
+        # each other must degrade to a fallback prefill, not deadlock
+        if not self._cond.acquire(timeout=1.0):
+            return None
+        try:
+            if self._fault is not None or self._stopped:
+                return None
+            pages = self.allocator.cached_chain(digests)
+            if pages:
+                r = len(pages)
+                try:
+                    buf = KVHandoffBuffer.prefix(
+                        version=self.model.version, page_size=ps,
+                        tokens=toks[:r * ps], digests=digests[:r],
+                        kv=self.model.export_kv(pages),
+                    )
+                except HandoffError:
+                    return None
+                self.kv_peer_serves += 1
+                return buf
+            if self._kv_host is not None:
+                for j in range(k_max, 0, -1):
+                    try:
+                        wire = self._kv_host.get(digests[j - 1])
+                        if wire is None:
+                            continue
+                        buf = KVHandoffBuffer.from_bytes(wire)
+                    except HandoffError:
+                        self._kv_host.discard(digests[j - 1])
+                        return None
+                    self.kv_peer_serves += 1
+                    return buf
+        finally:
+            self._cond.release()
+        return None
+
+    def kv_digest_report(self, limit: int = 512) -> Dict[str, Any]:
+        """The cache directory's per-replica digest summary (periodic
+        gateway poll — the /debug/routes hit/miss plumbing generalized):
+        device-resident cache keys (most-recent tail) plus the affinity
+        keys of host-tier entries, with occupancy and hit/miss/eviction
+        counts riding along for /debug/routes."""
+        with self._cond:
+            digests = self.allocator.cached_keys(limit=limit)
+            host = None
+            if self._kv_host is not None:
+                digests.extend(self._kv_host.akeys())
+                host = self._kv_host.stats()
+            return {
+                "digests": digests,
+                "host": host,
+                "prefix_cache": {
+                    "hits": self.allocator.prefix_hits,
+                    "misses": self.allocator.prefix_misses,
+                    "evictions": self.allocator.evictions,
+                },
+            }
 
     def _loop(self) -> None:
         while True:
@@ -1485,6 +1804,7 @@ class DecodeLoopExecutor:
                 req = slot.req
                 first_tok = int(picks[r, pick_idx])
                 self.allocator.register_prefix(req.tokens, slot.lease)
+                self._kv_note_chain(req.tokens)
                 slot.position = len(req.tokens)
                 slot.last_token = first_tok
                 if self._spec is not None:
@@ -1543,6 +1863,7 @@ class DecodeLoopExecutor:
                 [leaf[row0:n_prompt * ps] for leaf in buf.kv], dst
             )
         self.allocator.register_prefix(req.tokens, slot.lease)
+        self._kv_note_chain(req.tokens)
         slot.position = plen
         slot.last_token = buf.last_token
         if self._spec is not None:
@@ -2129,7 +2450,23 @@ class DecodeLoopExecutor:
                             + self.allocator.prefix_misses, 1
                         ), 4,
                     ),
+                    # ISSUE-17 bugfix: device-tier LRU drops used to be
+                    # invisible — occupancy looked fine while hot
+                    # prefixes silently churned
+                    "evictions_device": self.allocator.evictions,
                 },
+                # host-tier occupancy beside the hit/miss counters
+                # (null when the serve has no KVTierPolicy)
+                "kv_host": (
+                    {
+                        **self._kv_host.stats(),
+                        "restore_ms_mean": round(
+                            sum(self._kv_restore_ms)
+                            / len(self._kv_restore_ms), 3,
+                        ) if self._kv_restore_ms else 0.0,
+                    } if self._kv_host is not None else None
+                ),
+                "kv_peer_serves": self.kv_peer_serves,
             }
 
     # -- load reporting (progress → pod status → autoscaler) ----------------
@@ -2755,6 +3092,11 @@ def serve(env: Dict[str, str], stop: threading.Event) -> None:
             preemption=env.get("TFK8S_SERVE_PREEMPTION", "1") != "0",
             aging_s=float(env.get("TFK8S_SERVE_AGING_S", "5.0")),
             speculative=speculative,
+            # KV economy (runtime/kvtier): rendered only when the spec
+            # carries a KVTierPolicy — both default OFF, which keeps an
+            # absent policy bit-identical (no demotions, no peer pulls)
+            kv_host_bytes=int(env.get("TFK8S_KV_HOST_BYTES", "0")),
+            kv_peer_fetch=env.get("TFK8S_KV_PEER_FETCH", "0") != "0",
         ).start()
     else:
         model = make_model(task, checkpoint, max_batch, env)

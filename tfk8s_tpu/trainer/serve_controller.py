@@ -100,7 +100,13 @@ def _serve_version(serve: TPUServe) -> str:
     ``disaggregation`` joins the hash only when PRESENT (existing
     single-pool hashes are unchanged), and only by presence: pool
     COUNTS scale in place like ``spec.replicas`` — adding/removing the
-    block itself is what changes the pods' phase env and rolls."""
+    block itself is what changes the pods' phase env and rolls.
+    ``kv_tier`` joins WHOLE when present: its knobs render into the
+    pods' env (host-tier byte budget, peer fetch), so a knob edit must
+    roll the replicas — unlike the directory TTL, which only the
+    gateway reads, but hashing the block uniformly keeps the rule
+    simple (the TTL is a tuning knob nobody flips without also
+    reconsidering capacity)."""
     base = {
         "task": serve.spec.task,
         "checkpoint": serve.spec.checkpoint,
@@ -109,6 +115,8 @@ def _serve_version(serve: TPUServe) -> str:
     }
     if serve.spec.disaggregation is not None:
         base["disaggregation"] = True
+    if serve.spec.kv_tier is not None:
+        base["kv_tier"] = serde.to_wire(serve.spec.kv_tier)
     return template_hash(base)
 
 
@@ -164,6 +172,11 @@ def render_serve_pod(
     }
     if phase:
         env["TFK8S_SERVE_PHASE"] = phase
+    if spec.kv_tier is not None:
+        # KV economy (runtime/kvtier): host-tier byte budget + peer
+        # fetch render per replica; the directory TTL stays gateway-side
+        env["TFK8S_KV_HOST_BYTES"] = str(spec.kv_tier.host_bytes)
+        env["TFK8S_KV_PEER_FETCH"] = "1" if spec.kv_tier.peer_fetch else "0"
     lbls = L.serve_version_labels(serve.metadata.name, version)
     lbls[L.REPLICA_INDEX] = str(index)
     if phase:

@@ -36,6 +36,9 @@ SCOPE_PREFIXES = (
     # per-request sampling PRNG (seed + absolute-position fold) must
     # survive resume bit-identically — no wall-clock or ambient RNG
     "tfk8s_tpu/runtime/sched/",
+    # KV tiering (ISSUE 17): restores and directory staleness must be
+    # reproducible — monotonic clocks only, injected for tests
+    "tfk8s_tpu/runtime/kvtier/",
     "tests/chaos.py",
 )
 

@@ -35,6 +35,12 @@ SCOPE = (
     "tfk8s_tpu/runtime/handoff.py",
     "tfk8s_tpu/runtime/sched/scheduler.py",
     "tfk8s_tpu/runtime/sched/speculative.py",
+    # the KV economy (ISSUE 17): every tier failure must surface as
+    # HandoffError so the promote path can degrade to plain prefill
+    "tfk8s_tpu/runtime/kvtier/__init__.py",
+    "tfk8s_tpu/runtime/kvtier/host.py",
+    "tfk8s_tpu/runtime/kvtier/peer.py",
+    "tfk8s_tpu/runtime/kvtier/directory.py",
     "tfk8s_tpu/gateway/server.py",
     "tfk8s_tpu/gateway/affinity.py",
     "tfk8s_tpu/gateway/router.py",
